@@ -1,0 +1,20 @@
+"""commefficient_tpu — a TPU-native framework for communication-efficient
+federated learning (FetchSGD-style), built on JAX/XLA/pjit/Pallas.
+
+Capabilities mirror ahmedcs/CommEfficient (see SURVEY.md): five aggregation
+modes (sketch / true_topk / local_topk / fedavg / uncompressed), local and
+virtual momentum, local and virtual error feedback, differential privacy,
+per-client upload/download byte accounting, federated ResNets and GPT2.
+
+Where the reference simulates clients with a parameter-server process, GPU
+worker processes, shared memory and NCCL (reference fed_aggregator.py:54-381,
+fed_worker.py:14-138), this framework is one SPMD JAX program: a jitted
+federated round on a TPU mesh with a sharded ``clients`` axis, XLA collectives
+over ICI/DCN in place of NCCL, and a segment-sum/Pallas CountSketch in place of
+the external ``csvec`` package.
+"""
+
+from commefficient_tpu.config import FedConfig
+
+__version__ = "0.1.0"
+__all__ = ["FedConfig"]

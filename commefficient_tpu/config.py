@@ -1,0 +1,155 @@
+"""Frozen run configuration.
+
+The reference passes a mutable argparse namespace everywhere and mutates it as
+a grab-bag (reference utils.py:102-230, e.g. ``args.grad_size`` set inside
+FedModel at fed_aggregator.py:88). Here the configuration is a frozen
+dataclass: derived fields are computed once via ``finalize`` and the object is
+hashable, so it can be closed over by jitted functions as a static value.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+MODES = ("sketch", "true_topk", "local_topk", "fedavg", "uncompressed")
+ERROR_TYPES = ("none", "local", "virtual")
+DP_MODES = ("worker", "server")
+
+
+@dataclass(frozen=True)
+class FedConfig:
+    """All knobs for a federated run (flag parity: reference utils.py:102-230)."""
+
+    # meta
+    mode: str = "sketch"
+    seed: int = 21
+    do_test: bool = False  # smoke mode: fake gradients, 1 batch per epoch
+
+    # model / data
+    model: str = "ResNet9"
+    dataset_name: str = "CIFAR10"
+    dataset_dir: str = "./dataset"
+    do_batchnorm: bool = False
+    do_iid: bool = False
+    nan_threshold: float = 999.0
+    num_channels: int = 3  # input channels (1 for EMNIST)
+    num_classes: int = 10
+
+    # compression
+    k: int = 50_000
+    num_cols: int = 500_000
+    num_rows: int = 5
+    num_blocks: int = 20
+    do_topk_down: bool = False
+
+    # optimization. NOTE: the reference defaults local_momentum to 0.9
+    # (utils.py:151) which is invalid with its own default mode='sketch'
+    # (fed_worker.py:228 asserts velocity is None for sketch); we default to
+    # 0.0 so the zero-argument config is runnable.
+    local_momentum: float = 0.0
+    virtual_momentum: float = 0.0
+    weight_decay: float = 5e-4
+    num_epochs: float = 24
+    num_fedavg_epochs: int = 1
+    fedavg_batch_size: int = -1
+    fedavg_lr_decay: float = 1.0
+    error_type: str = "none"
+    lr_scale: float = 0.4
+    pivot_epoch: float = 5
+    max_grad_norm: Optional[float] = None
+
+    # federated dimensions
+    num_clients: int = 10
+    num_workers: int = 1  # clients sampled per round
+    local_batch_size: int = 8  # -1 => each client's whole dataset per round
+    valid_batch_size: int = 8
+    microbatch_size: int = -1
+
+    # parallelization (mesh, not processes)
+    mesh_shape: Tuple[int, ...] = (1,)
+    mesh_axis_names: Tuple[str, ...] = ("clients",)
+
+    # GPT2 / NLP
+    model_checkpoint: str = "gpt2"
+    num_candidates: int = 2
+    max_history: int = 2
+    lm_coef: float = 1.0
+    mc_coef: float = 1.0
+    personality_permutations: int = 1
+    max_seq_len: int = 256
+
+    # differential privacy
+    do_dp: bool = False
+    dp_mode: str = "worker"
+    l2_norm_clip: float = 1.0
+    noise_multiplier: float = 0.0
+
+    # derived (set by finalize)
+    grad_size: int = 0
+
+    def finalize(self, grad_size: int) -> "FedConfig":
+        """Return a copy with derived fields filled in and invariants checked."""
+        cfg = dataclasses.replace(self, grad_size=int(grad_size))
+        cfg.validate()
+        return cfg
+
+    def validate(self) -> None:
+        if self.mode not in MODES:
+            raise ValueError(f"mode must be one of {MODES}, got {self.mode!r}")
+        if self.error_type not in ERROR_TYPES:
+            raise ValueError(
+                f"error_type must be one of {ERROR_TYPES}, got {self.error_type!r}")
+        if self.dp_mode not in DP_MODES:
+            raise ValueError(f"dp_mode must be one of {DP_MODES}")
+        # parse-time invariants, reference utils.py:225-228
+        if self.mode == "fedavg":
+            if self.local_batch_size != -1:
+                raise ValueError("fedavg requires local_batch_size == -1")
+            if self.local_momentum != 0:
+                raise ValueError("fedavg requires local_momentum == 0")
+            if self.error_type != "none":
+                raise ValueError("fedavg requires error_type == 'none'")
+        # math-level invariants, reference fed_worker.py:221-228 and
+        # fed_aggregator.py:572-576
+        if self.error_type == "local" and self.mode in ("sketch", "uncompressed"):
+            raise ValueError(
+                "local error accumulation is undefined for mode "
+                f"{self.mode!r} (no support to zero)")
+        if self.mode == "sketch" and self.local_momentum != 0:
+            raise ValueError("momentum factor masking is impossible in "
+                             "sketch space; local_momentum must be 0")
+        if self.mode == "local_topk" and self.error_type == "virtual":
+            raise ValueError("local_topk supports error_type in {none, local}")
+        if self.mode == "true_topk" and self.error_type != "virtual":
+            raise ValueError("true_topk requires error_type == 'virtual'")
+
+    # --- shapes -----------------------------------------------------------
+    @property
+    def transmit_shape(self) -> Tuple[int, ...]:
+        """Shape of the quantity a worker transmits (ref fed_worker.py:44-48)."""
+        if self.mode == "sketch":
+            return (self.num_rows, self.num_cols)
+        return (self.grad_size,)
+
+    @property
+    def needs_velocity_state(self) -> bool:
+        return self.local_momentum > 0 and self.mode != "sketch"
+
+    @property
+    def needs_error_state(self) -> bool:
+        return self.error_type == "local"
+
+    @property
+    def needs_client_weights(self) -> bool:
+        return self.do_topk_down
+
+    @property
+    def upload_floats_per_client(self) -> int:
+        """Floats uploaded per client per round (ref fed_aggregator.py:291-299)."""
+        if self.mode == "sketch":
+            return self.num_rows * self.num_cols
+        if self.mode == "local_topk":
+            return self.k
+        return self.grad_size

@@ -1,0 +1,4 @@
+from commefficient_tpu.federated.server import server_update, init_server_opt_state
+from commefficient_tpu.federated.state import ServerOptState
+
+__all__ = ["server_update", "init_server_opt_state", "ServerOptState"]

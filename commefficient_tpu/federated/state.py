@@ -1,0 +1,49 @@
+"""Functional state containers for the federated round.
+
+The reference keeps this state as mutable module-level globals and
+shared-memory tensors (reference fed_aggregator.py:37-44, 94-129,
+408-409). Here it is explicit, immutable pytrees threaded through the jitted
+round function; ``jax.jit(donate_argnums=...)`` recovers in-place memory
+behavior without the aliasing hazards.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from flax import struct
+
+
+@struct.dataclass
+class ServerOptState:
+    """Virtual momentum / error vectors (ref fed_aggregator.py:408-409).
+
+    Shapes: ``(grad_size,)`` for dense modes, ``(num_rows, num_cols)`` for
+    sketch mode.
+    """
+    Vvelocity: jax.Array
+    Verror: jax.Array
+
+
+@struct.dataclass
+class ClientState:
+    """Per-client persistent state, rows indexed by client id.
+
+    The reference allocates these as host shared-memory tensors of shape
+    ``(num_clients, grad_size)`` or ``(num_clients, r, c)``
+    (fed_aggregator.py:116-129). Here they are device arrays sharded along
+    the leading ``clients`` axis of the mesh. Fields are ``None`` when the
+    run's mode doesn't need them.
+    """
+    velocities: Optional[jax.Array] = None  # local momentum state
+    errors: Optional[jax.Array] = None      # local error-feedback state
+    weights: Optional[jax.Array] = None     # stale weights for topk_down
+
+
+@struct.dataclass
+class RoundOutput:
+    """What one federated round produces (metrics are sums over datapoints)."""
+    loss_sum: jax.Array
+    metric_sums: jax.Array   # e.g. (num_extra_metrics,) summed over datapoints
+    num_datapoints: jax.Array

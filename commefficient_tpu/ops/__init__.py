@@ -1,0 +1,4 @@
+from commefficient_tpu.ops.topk import topk
+from commefficient_tpu.ops.countsketch import CountSketch
+
+__all__ = ["topk", "CountSketch"]

@@ -1,0 +1,31 @@
+"""Magnitude top-k as a dense masked vector.
+
+Semantics of the reference ``_topk`` (reference utils.py:232-252): return a
+vector of the same shape as ``vec`` holding the k largest-magnitude entries
+and zero elsewhere; 2-D inputs take k per row. The reference needs CUDA for
+this to be fast ("topk is impossibly slow on CPU, very fast on GPU",
+reference fed_worker.py:206); on TPU ``jax.lax.top_k`` maps directly onto the
+hardware sort unit, and the dense-masked formulation keeps shapes static for
+XLA.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _topk_1d(vec: jax.Array, k: int) -> jax.Array:
+    _, idx = jax.lax.top_k(vec * vec, k)
+    mask = jnp.zeros(vec.shape, dtype=bool).at[idx].set(True)
+    return jnp.where(mask, vec, 0)
+
+
+@partial(jax.jit, static_argnames="k")
+def topk(vec: jax.Array, k: int) -> jax.Array:
+    """Zero all but the k largest-magnitude entries (per row if 2-D)."""
+    if vec.ndim == 1:
+        return _topk_1d(vec, k)
+    if vec.ndim == 2:
+        return jax.vmap(_topk_1d, in_axes=(0, None))(vec, k)
+    raise ValueError(f"topk supports 1-D/2-D inputs, got ndim={vec.ndim}")

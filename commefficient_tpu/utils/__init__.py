@@ -1,0 +1,6 @@
+from commefficient_tpu.utils.params import flatten_params, make_unflatten
+from commefficient_tpu.utils.schedules import PiecewiseLinear, Exp
+from commefficient_tpu.utils.logging import Logger, TableLogger, TSVLogger, Timer
+
+__all__ = ["flatten_params", "make_unflatten", "PiecewiseLinear", "Exp",
+           "Logger", "TableLogger", "TSVLogger", "Timer"]

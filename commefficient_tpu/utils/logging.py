@@ -1,0 +1,71 @@
+"""Console/TSV loggers and wall-clock timer (reference utils.py:14-99)."""
+
+from __future__ import annotations
+
+import os
+import time
+from datetime import datetime
+
+
+class Logger:
+    def __init__(self, verbose: bool = True):
+        self.verbose = verbose
+
+    def debug(self, *args, **kwargs):
+        if self.verbose:
+            print(*args, **kwargs)
+
+    def info(self, *args, **kwargs):
+        print(*args, **kwargs)
+
+
+class TableLogger:
+    """Fixed-width column table; header printed on first append."""
+
+    def __init__(self):
+        self.keys = None
+
+    def append(self, output: dict):
+        if self.keys is None:
+            self.keys = list(output.keys())
+            print(*(f"{k:>12s}" for k in self.keys))
+        filtered = [output.get(k, "") for k in self.keys]
+        print(*(f"{v:12.4f}" if isinstance(v, float) else f"{str(v):>12s}"
+                for v in filtered))
+
+
+class TSVLogger:
+    def __init__(self):
+        self.log = ["epoch\thours\ttop1Accuracy"]
+
+    def append(self, output: dict):
+        epoch = output.get("epoch", -1)
+        hours = output.get("total_time", 0) / 3600
+        acc = output.get("test_acc", 0) * 100
+        self.log.append(f"{epoch}\t{hours:.8f}\t{acc:.2f}")
+
+    def __str__(self):
+        return "\n".join(self.log)
+
+
+class Timer:
+    def __init__(self, synch=None):
+        self.synch = synch or (lambda: None)
+        self.times = [time.perf_counter()]
+        self.total_time = 0.0
+
+    def __call__(self, include_in_total: bool = True):
+        self.synch()
+        self.times.append(time.perf_counter())
+        delta_t = self.times[-1] - self.times[-2]
+        if include_in_total:
+            self.total_time += delta_t
+        return delta_t
+
+
+def make_logdir(cfg) -> str:
+    """runs/<timestamp>_<workers>/<clients>_<mode> (ref utils.py:51-64)."""
+    current_time = datetime.now().strftime("%b%d_%H-%M-%S")
+    run_name = f"{current_time}_{cfg.num_workers}"
+    detail = f"{cfg.num_clients}_{cfg.mode}"
+    return os.path.join("runs", run_name, detail)

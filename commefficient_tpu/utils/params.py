@@ -1,0 +1,36 @@
+"""Pytree <-> flat vector conversion at the compression boundary.
+
+The reference flattens the whole model into a single float vector and keeps
+it that way globally (reference utils.py:254-297: get_param_vec/set_param_vec
+iterate ``requires_grad`` parameters in module order). In JAX, parameters stay
+a pytree everywhere except the compression boundary, where
+``jax.flatten_util.ravel_pytree`` provides the flat view and its inverse.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+
+def flatten_params(params: Any) -> Tuple[jax.Array, Callable[[jax.Array], Any]]:
+    """Return (flat_vector, unflatten_fn). Deterministic pytree order.
+
+    Preserves dtype: compression math that needs f32 must cast explicitly at
+    the boundary (and cast back), otherwise bf16 models would silently become
+    f32 on a round trip.
+    """
+    flat, unflatten = ravel_pytree(params)
+    return flat, unflatten
+
+
+def make_unflatten(params: Any) -> Callable[[jax.Array], Any]:
+    _, unflatten = ravel_pytree(params)
+    return unflatten
+
+
+def grad_size_of(params: Any) -> int:
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))

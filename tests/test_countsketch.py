@@ -1,0 +1,57 @@
+import jax.numpy as jnp
+import numpy as np
+
+from commefficient_tpu.ops import CountSketch
+
+
+def test_linearity():
+    cs = CountSketch(d=100, c=50, r=3, seed=7)
+    rng = np.random.RandomState(0)
+    a = jnp.asarray(rng.randn(100).astype(np.float32))
+    b = jnp.asarray(rng.randn(100).astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(cs.sketch_vec(a + b)),
+        np.asarray(cs.sketch_vec(a) + cs.sketch_vec(b)), rtol=1e-5, atol=1e-5)
+
+
+def test_determinism_and_seed_sensitivity():
+    a = jnp.asarray(np.random.RandomState(0).randn(64).astype(np.float32))
+    t1 = np.asarray(CountSketch(64, 32, 3, seed=42).sketch_vec(a))
+    t2 = np.asarray(CountSketch(64, 32, 3, seed=42).sketch_vec(a))
+    t3 = np.asarray(CountSketch(64, 32, 3, seed=43).sketch_vec(a))
+    np.testing.assert_array_equal(t1, t2)
+    assert not np.array_equal(t1, t3)
+
+
+def test_unsketch_recovers_heavy_hitters():
+    # big sketch (c >> d): recovery should be near-exact
+    d, k = 500, 20
+    cs = CountSketch(d=d, c=20_000, r=5, seed=3)
+    rng = np.random.RandomState(5)
+    vec = rng.randn(d).astype(np.float32) * 0.01
+    hh_idx = rng.choice(d, k, replace=False)
+    vec[hh_idx] += np.sign(rng.randn(k)) * 10.0
+    table = cs.sketch_vec(jnp.asarray(vec))
+    rec = np.asarray(cs.unsketch(table, k))
+    # recovered support must be exactly the heavy hitters
+    assert set(np.flatnonzero(rec)) == set(hh_idx)
+    np.testing.assert_allclose(rec[hh_idx], vec[hh_idx], rtol=1e-3, atol=1e-2)
+
+
+def test_l2estimate():
+    d = 2000
+    cs = CountSketch(d=d, c=50_000, r=5, seed=11)
+    vec = np.random.RandomState(2).randn(d).astype(np.float32)
+    est = float(cs.l2estimate(cs.sketch_vec(jnp.asarray(vec))))
+    true = float(np.linalg.norm(vec))
+    assert abs(est - true) / true < 0.05
+
+
+def test_table_accumulation_is_addition():
+    cs = CountSketch(d=30, c=16, r=2, seed=1)
+    a = jnp.asarray(np.random.RandomState(0).randn(30).astype(np.float32))
+    t = cs.zero_table()
+    t = cs.accumulate_vec(t, a)
+    t = cs.accumulate_vec(t, a)
+    np.testing.assert_allclose(np.asarray(t),
+                               np.asarray(2 * cs.sketch_vec(a)), rtol=1e-5)

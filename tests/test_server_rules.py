@@ -1,0 +1,211 @@
+"""Golden-value and equivalence tests for the five server update rules.
+
+Hand-derived on the reference's toy problem (reference unit_test.py:79-110):
+model y = w*x, data x = [0,1,2,3], targets y = x, per-example loss
+(w*x - y)^2. The round's aggregate gradient is the *mean* over datapoints
+(the aggregator divides the summed transmit by total batch size, reference
+fed_aggregator.py:332):
+
+    mean_grad(w) = (1/4) * sum_i 2*(w-1)*x_i^2 = 7*(w-1)
+
+With lr = 0.02 and w0 = 0:
+  step 1: g1 = -7
+  step 2 (at w1): g2 = 7*(w1 - 1)
+
+Derivations per mode are inline below.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from commefficient_tpu.config import FedConfig
+from commefficient_tpu.federated.server import (
+    init_server_opt_state, make_sketch, server_update)
+
+LR = 0.02
+
+
+def mean_grad(w):
+    return 7.0 * (w - 1.0)
+
+
+def run_two_steps(cfg, lr=LR):
+    """Drive two rounds of w -= update on the toy problem; return trajectory."""
+    sketch = make_sketch(cfg) if cfg.mode == "sketch" else None
+    state = init_server_opt_state(cfg)
+    w = jnp.zeros(cfg.grad_size)
+    ws = []
+    for _ in range(2):
+        g_dense = jnp.full((cfg.grad_size,), mean_grad(float(w[0])))
+        g = sketch.sketch_vec(g_dense) if cfg.mode == "sketch" else g_dense
+        update, state = server_update(g, state, cfg, lr, sketch=sketch)
+        w = w - update
+        ws.append(float(w[0]))
+    return ws
+
+
+def test_uncompressed_momentum_golden():
+    # v1 = -7            -> w1 = 0 + .02*7        = 0.14
+    # g2 = 7*(0.14-1) = -6.02
+    # v2 = -6.02 + .9*(-7) = -12.32 -> w2 = 0.14 + .02*12.32 = 0.3864
+    cfg = FedConfig(mode="uncompressed", virtual_momentum=0.9,
+                    local_momentum=0, error_type="none").finalize(1)
+    w1, w2 = run_two_steps(cfg)
+    assert w1 == pytest.approx(0.14, abs=1e-6)
+    assert w2 == pytest.approx(0.3864, abs=1e-6)
+
+
+def test_uncompressed_no_momentum_golden():
+    # plain SGD: w1 = 0.14, w2 = 0.14 + .02*6.02 = 0.2604
+    cfg = FedConfig(mode="uncompressed", virtual_momentum=0.0,
+                    local_momentum=0, error_type="none").finalize(1)
+    w1, w2 = run_two_steps(cfg)
+    assert w1 == pytest.approx(0.14, abs=1e-6)
+    assert w2 == pytest.approx(0.2604, abs=1e-6)
+
+
+def test_fedavg_momentum_on_avg_update():
+    # fedavg: lr lives worker-side; server applies momentum to the avg
+    # weight-delta. Feeding delta = lr*mean_grad reproduces uncompressed SGD
+    # trajectories (with momentum on the *scaled* update).
+    cfg = FedConfig(mode="fedavg", virtual_momentum=0.9, local_momentum=0,
+                    error_type="none", local_batch_size=-1).finalize(1)
+    state = init_server_opt_state(cfg)
+    w = 0.0
+    # step 1
+    upd, state = server_update(jnp.array([LR * mean_grad(w)]), state, cfg, 1.0)
+    w -= float(upd[0])
+    assert w == pytest.approx(0.14, abs=1e-6)
+    # step 2: v2 = .02*(-6.02) + .9*(.02*(-7)) = -.2464 -> w2 = 0.3864
+    upd, state = server_update(jnp.array([LR * mean_grad(w)]), state, cfg, 1.0)
+    w -= float(upd[0])
+    assert w == pytest.approx(0.3864, abs=1e-6)
+
+
+def test_true_topk_k_equals_d_is_sgd_without_momentum_carry():
+    # k = d: every coordinate is in the top-k, so error feedback and factor
+    # masking zero the whole state each round -> trajectory equals plain SGD
+    # even with virtual_momentum set.
+    d = 5
+    cfg = FedConfig(mode="true_topk", error_type="virtual", k=d,
+                    virtual_momentum=0.9, local_momentum=0).finalize(d)
+    w1, w2 = run_two_steps(cfg)
+    assert w1 == pytest.approx(0.14, abs=1e-6)
+    assert w2 == pytest.approx(0.2604, abs=1e-6)
+
+
+def test_true_topk_sparsifies_and_accumulates_error():
+    # d=2, k=1, gradient (3, 1): update keeps only the big coord; the small
+    # coord accumulates in Verror and is applied next round.
+    cfg = FedConfig(mode="true_topk", error_type="virtual", k=1,
+                    virtual_momentum=0.0, local_momentum=0).finalize(2)
+    state = init_server_opt_state(cfg)
+    g = jnp.asarray([3.0, 1.0])
+    upd, state = server_update(g, state, cfg, 1.0)
+    np.testing.assert_allclose(np.asarray(upd), [3.0, 0.0])
+    np.testing.assert_allclose(np.asarray(state.Verror), [0.0, 1.0])
+    # second round, same gradient: error makes coord 1 win? 1+1=2 < 3 no;
+    # coord0 transmitted again, coord1 error grows to 2
+    upd, state = server_update(g, state, cfg, 1.0)
+    np.testing.assert_allclose(np.asarray(upd), [3.0, 0.0])
+    np.testing.assert_allclose(np.asarray(state.Verror), [0.0, 2.0])
+    # with zero gradient the accumulated error finally transmits
+    upd, state = server_update(jnp.zeros(2), state, cfg, 1.0)
+    np.testing.assert_allclose(np.asarray(upd), [0.0, 2.0])
+    np.testing.assert_allclose(np.asarray(state.Verror), [0.0, 0.0])
+
+
+def test_local_topk_momentum():
+    # momentum accumulates on the summed worker top-ks, no masking
+    cfg = FedConfig(mode="local_topk", error_type="none", k=1,
+                    virtual_momentum=0.5, local_momentum=0).finalize(2)
+    state = init_server_opt_state(cfg)
+    g = jnp.asarray([2.0, 0.0])
+    upd, state = server_update(g, state, cfg, 1.0)
+    np.testing.assert_allclose(np.asarray(upd), [2.0, 0.0])
+    upd, state = server_update(g, state, cfg, 1.0)
+    np.testing.assert_allclose(np.asarray(upd), [3.0, 0.0])  # 2 + .5*2
+
+
+def test_sketch_large_matches_true_topk():
+    # A big sketch recovers the top-k exactly with overwhelming probability,
+    # so sketched FetchSGD == true_topk trajectories (SURVEY.md §4 property).
+    d, k = 50, 5
+    rng = np.random.RandomState(0)
+    g1 = np.zeros(d, np.float32)
+    g1[rng.choice(d, k, replace=False)] = rng.randn(k) * 5 + 10
+    g2 = np.zeros(d, np.float32)
+    g2[rng.choice(d, k, replace=False)] = rng.randn(k) * 5 - 10
+
+    cfg_t = FedConfig(mode="true_topk", error_type="virtual", k=k,
+                      virtual_momentum=0.9, local_momentum=0).finalize(d)
+    cfg_s = FedConfig(mode="sketch", error_type="virtual", k=k,
+                      virtual_momentum=0.9, local_momentum=0,
+                      num_rows=7, num_cols=5000).finalize(d)
+    sketch = make_sketch(cfg_s)
+
+    st_t = init_server_opt_state(cfg_t)
+    st_s = init_server_opt_state(cfg_s)
+    for g in (g1, g2):
+        upd_t, st_t = server_update(jnp.asarray(g), st_t, cfg_t, 1.0)
+        upd_s, st_s = server_update(sketch.sketch_vec(jnp.asarray(g)),
+                                    st_s, cfg_s, 1.0, sketch=sketch)
+        np.testing.assert_allclose(np.asarray(upd_s), np.asarray(upd_t),
+                                   rtol=1e-3, atol=1e-3)
+
+
+def test_sketch_error_feedback_carries_small_coords():
+    # one big + one small coordinate, k=1: the small one must eventually be
+    # applied thanks to virtual error accumulation in sketch space
+    d = 20
+    cfg = FedConfig(mode="sketch", error_type="virtual", k=1,
+                    virtual_momentum=0.0, local_momentum=0,
+                    num_rows=5, num_cols=2000).finalize(d)
+    sketch = make_sketch(cfg)
+    state = init_server_opt_state(cfg)
+    g = np.zeros(d, np.float32)
+    g[3], g[11] = 10.0, 4.0
+    upd, state = server_update(sketch.sketch_vec(jnp.asarray(g)), state, cfg, 1.0,
+                               sketch=sketch)
+    assert np.flatnonzero(np.asarray(upd)).tolist() == [3]
+    # error now holds ~4.0 at coord 11; zero grad lets it transmit
+    upd, state = server_update(sketch.zero_table(), state, cfg, 1.0,
+                               sketch=sketch)
+    assert np.flatnonzero(np.asarray(upd)).tolist() == [11]
+    assert float(upd[11]) == pytest.approx(4.0, rel=1e-3)
+
+
+def test_dp_server_requires_fresh_rng():
+    cfg = FedConfig(mode="uncompressed", virtual_momentum=0.0,
+                    local_momentum=0, error_type="none", do_dp=True,
+                    dp_mode="server", noise_multiplier=1.0).finalize(10)
+    state = init_server_opt_state(cfg)
+    with pytest.raises(ValueError, match="noise_rng"):
+        server_update(jnp.ones(10), state, cfg, 1.0)
+
+
+def test_dp_server_noise_changes_update():
+    cfg = FedConfig(mode="uncompressed", virtual_momentum=0.0,
+                    local_momentum=0, error_type="none", do_dp=True,
+                    dp_mode="server", noise_multiplier=1.0).finalize(10)
+    state = init_server_opt_state(cfg)
+    g = jnp.ones(10)
+    u1, _ = server_update(g, state, cfg, 1.0,
+                          noise_rng=jax.random.PRNGKey(1))
+    u2, _ = server_update(g, state, cfg, 1.0,
+                          noise_rng=jax.random.PRNGKey(2))
+    assert not np.allclose(np.asarray(u1), np.asarray(u2))
+    assert np.std(np.asarray(u1) - np.ones(10)) > 0.1
+
+
+def test_lr_vector_per_param_groups():
+    # Fixup-style per-parameter learning rates (ref fed_aggregator.py:411-427)
+    cfg = FedConfig(mode="uncompressed", virtual_momentum=0.0,
+                    local_momentum=0, error_type="none").finalize(4)
+    state = init_server_opt_state(cfg)
+    g = jnp.ones(4)
+    lr_vec = jnp.asarray([0.1, 0.1, 0.5, 0.5])
+    upd, _ = server_update(g, state, cfg, lr_vec)
+    np.testing.assert_allclose(np.asarray(upd), [0.1, 0.1, 0.5, 0.5])

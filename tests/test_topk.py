@@ -1,0 +1,33 @@
+import jax.numpy as jnp
+import numpy as np
+
+from commefficient_tpu.ops import topk
+
+
+def _ref_topk(vec, k):
+    out = np.zeros_like(vec)
+    idx = np.argsort(vec ** 2)[-k:]
+    out[idx] = vec[idx]
+    return out
+
+
+def test_topk_1d_matches_numpy():
+    rng = np.random.RandomState(0)
+    vec = rng.randn(1000).astype(np.float32)
+    for k in (1, 10, 999, 1000):
+        got = np.asarray(topk(jnp.asarray(vec), k))
+        np.testing.assert_allclose(got, _ref_topk(vec, k), rtol=1e-6)
+
+
+def test_topk_2d_per_row():
+    rng = np.random.RandomState(1)
+    mat = rng.randn(5, 200).astype(np.float32)
+    got = np.asarray(topk(jnp.asarray(mat), 7))
+    for i in range(5):
+        np.testing.assert_allclose(got[i], _ref_topk(mat[i], 7), rtol=1e-6)
+
+
+def test_topk_keeps_signs_and_count():
+    vec = jnp.asarray([-5.0, 1.0, 3.0, -2.0, 0.5])
+    got = np.asarray(topk(vec, 2))
+    np.testing.assert_allclose(got, [-5.0, 0, 3.0, 0, 0])
