@@ -1,0 +1,63 @@
+"""Benchmark: federated rounds/sec for sketched FetchSGD, ResNet-9 @ CIFAR10
+shapes, on the attached TPU chip. Prints ONE JSON line.
+
+The metric matches BASELINE.json's north star ("CIFAR10 ResNet-9 fed
+rounds/sec"). One round = 8 simulated clients x 32 images each (256
+images/round), full FetchSGD pipeline: per-client grad, 5x500k CountSketch,
+aggregation, unsketch top-k=50k, error feedback — the reference's default
+sketch config (reference utils.py:142-145). The reference publishes no
+numbers (BASELINE.md), so vs_baseline is reported as 1.0 by convention.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    from commefficient_tpu.config import FedConfig
+    from commefficient_tpu.federated.api import FedLearner
+    from commefficient_tpu.federated.losses import make_cv_loss
+    from commefficient_tpu.models import ResNet9
+
+    W, B = 8, 32
+    model = ResNet9(num_classes=10)
+    cfg = FedConfig(mode="sketch", error_type="virtual", virtual_momentum=0.9,
+                    local_momentum=0, k=50_000, num_rows=5, num_cols=500_000,
+                    num_workers=W, num_clients=100, lr_scale=0.4,
+                    weight_decay=5e-4)
+    rng = np.random.RandomState(0)
+    images = rng.randn(W, B, 32, 32, 3).astype(np.float32)
+    targets = rng.randint(0, 10, (W, B)).astype(np.int32)
+    mask = np.ones((W, B), np.float32)
+
+    learner = FedLearner(model, cfg, make_cv_loss(model), None,
+                         jax.random.PRNGKey(0), images[0][:1])
+
+    def one_round(r):
+        ids = (np.arange(W) + r * W) % cfg.num_clients
+        return learner.train_round(ids, (images, targets), mask)
+
+    one_round(0)  # compile
+    one_round(1)  # warm
+    n = 10
+    t0 = time.perf_counter()
+    for r in range(n):
+        out = one_round(2 + r)
+    jax.block_until_ready(learner.state.weights)
+    dt = time.perf_counter() - t0
+
+    rounds_per_sec = n / dt
+    print(json.dumps({
+        "metric": "cifar10_resnet9_fed_rounds_per_sec",
+        "value": round(rounds_per_sec, 4),
+        "unit": "rounds/sec",
+        "vs_baseline": 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

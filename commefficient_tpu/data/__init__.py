@@ -1,0 +1,19 @@
+from commefficient_tpu.data.fed_dataset import FedDataset
+from commefficient_tpu.data.cifar import FedCIFAR10, FedCIFAR100
+from commefficient_tpu.data.emnist import FedEMNIST
+from commefficient_tpu.data.imagenet import FedImageNet
+from commefficient_tpu.data.synthetic import SyntheticCV
+from commefficient_tpu.data.sampler import FedSampler
+from commefficient_tpu.data.batching import FedBatcher, val_batches
+
+fed_datasets = {
+    "CIFAR10": FedCIFAR10,
+    "CIFAR100": FedCIFAR100,
+    "EMNIST": FedEMNIST,
+    "ImageNet": FedImageNet,
+    "Synthetic": SyntheticCV,
+}
+
+__all__ = ["FedDataset", "FedCIFAR10", "FedCIFAR100", "FedEMNIST",
+           "FedImageNet", "SyntheticCV", "FedSampler", "FedBatcher",
+           "val_batches", "fed_datasets"]

@@ -1,0 +1,70 @@
+"""Federated ImageNet: each wnid class directory is one client (reference
+data_utils/fed_imagenet.py:12-76).
+
+Expects the standard extracted layout ``<dir>/{train,val}/<wnid>/*.JPEG``.
+Decoding uses PIL if available, gated with a clear error otherwise (this
+image has no network egress and may lack PIL)."""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+import numpy as np
+
+from commefficient_tpu.data.fed_dataset import FedDataset
+
+
+class FedImageNet(FedDataset):
+    image_size = 224
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        split = "train" if self.train else "val"
+        d = os.path.join(self.dataset_dir, split)
+        self.wnids = sorted(os.listdir(d)) if os.path.isdir(d) else []
+        self.files = {w: sorted(glob.glob(os.path.join(d, w, "*")))
+                      for w in self.wnids}
+        if not self.train:
+            self.val_list = [(f, i) for i, w in enumerate(self.wnids)
+                             for f in self.files[w]]
+
+    def prepare_datasets(self):
+        train_dir = os.path.join(self.dataset_dir, "train")
+        if not os.path.isdir(train_dir):
+            raise FileNotFoundError(
+                f"ImageNet not found under {self.dataset_dir} (can't "
+                f"download ImageNet; extract it there or use Synthetic)")
+        wnids = sorted(os.listdir(train_dir))
+        per_client = [len(glob.glob(os.path.join(train_dir, w, "*")))
+                      for w in wnids]
+        n_val = len(glob.glob(os.path.join(self.dataset_dir, "val", "*",
+                                           "*")))
+        with open(self.stats_fn(), "w") as f:
+            json.dump({"images_per_client": per_client,
+                       "num_val_images": n_val}, f)
+
+    def _decode(self, paths):
+        try:
+            from PIL import Image
+        except ImportError:
+            raise ImportError("PIL is required to decode ImageNet JPEGs "
+                              "in this environment") from None
+        s = self.image_size
+        out = np.zeros((len(paths), s, s, 3), np.float32)
+        for i, p in enumerate(paths):
+            img = Image.open(p).convert("RGB").resize((s, s))
+            out[i] = np.asarray(img, np.float32) / 255.0
+        return out
+
+    def _get_train_batch(self, client_id: int, idxs: np.ndarray):
+        w = self.wnids[client_id]
+        paths = [self.files[w][i] for i in idxs]
+        return (self._decode(paths),
+                np.full(len(idxs), client_id, np.int32))
+
+    def _get_val_batch(self, idxs: np.ndarray):
+        pairs = [self.val_list[i] for i in idxs]
+        return (self._decode([p for p, _ in pairs]),
+                np.asarray([t for _, t in pairs], np.int32))

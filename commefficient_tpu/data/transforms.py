@@ -1,0 +1,100 @@
+"""Host-side numpy augmentation pipelines (reference
+data_utils/transforms.py:3-75, torchvision-based there).
+
+Images flow as NHWC float32. Each transform is
+``fn(cols, rng) -> cols`` over the batch's column list (first column is the
+image batch), so pipelines compose with plain function composition.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+CIFAR10_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
+CIFAR10_STD = np.array([0.2471, 0.2435, 0.2616], np.float32)
+CIFAR100_MEAN = np.array([0.5071, 0.4867, 0.4408], np.float32)
+CIFAR100_STD = np.array([0.2675, 0.2565, 0.2761], np.float32)
+FEMNIST_MEAN = np.array([0.9637], np.float32)
+FEMNIST_STD = np.array([0.1597], np.float32)
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+
+def normalize(mean, std):
+    def fn(cols, rng):
+        was_uint8 = cols[0].dtype == np.uint8
+        img = cols[0].astype(np.float32)
+        if was_uint8:
+            img = img / 255.0
+        cols[0] = (img - mean) / std
+        return cols
+    return fn
+
+
+def random_crop(size: int, padding: int, mode: str = "reflect",
+                fill: float = 0.0):
+    def fn(cols, rng):
+        img = cols[0]
+        if mode == "reflect":
+            padded = np.pad(img, ((0, 0), (padding, padding),
+                                  (padding, padding), (0, 0)), mode="reflect")
+        else:
+            padded = np.pad(img, ((0, 0), (padding, padding),
+                                  (padding, padding), (0, 0)),
+                            mode="constant", constant_values=fill)
+        out = np.empty_like(img)
+        for i in range(img.shape[0]):
+            y = rng.randint(0, 2 * padding + 1)
+            x = rng.randint(0, 2 * padding + 1)
+            out[i] = padded[i, y:y + size, x:x + size]
+        cols[0] = out
+        return cols
+    return fn
+
+
+def random_hflip(p: float = 0.5):
+    def fn(cols, rng):
+        img = cols[0]
+        flips = rng.rand(img.shape[0]) < p
+        img = img.copy()
+        img[flips] = img[flips, :, ::-1]
+        cols[0] = img
+        return cols
+    return fn
+
+
+def compose(*fns):
+    def fn(cols, rng):
+        for f in fns:
+            cols = f(list(cols), rng)
+        return cols
+    return fn
+
+
+cifar10_train_transforms = compose(
+    normalize(CIFAR10_MEAN, CIFAR10_STD),
+    random_crop(32, 4, "reflect"), random_hflip())
+cifar10_test_transforms = normalize(CIFAR10_MEAN, CIFAR10_STD)
+cifar100_train_transforms = compose(
+    normalize(CIFAR100_MEAN, CIFAR100_STD),
+    random_crop(32, 4, "reflect"), random_hflip())
+cifar100_test_transforms = normalize(CIFAR100_MEAN, CIFAR100_STD)
+femnist_train_transforms = compose(
+    normalize(FEMNIST_MEAN, FEMNIST_STD),
+    random_crop(28, 2, "constant", fill=1.0))
+femnist_test_transforms = normalize(FEMNIST_MEAN, FEMNIST_STD)
+imagenet_train_transforms = compose(
+    normalize(IMAGENET_MEAN, IMAGENET_STD), random_hflip())
+imagenet_val_transforms = normalize(IMAGENET_MEAN, IMAGENET_STD)
+
+
+def get_transforms(dataset_name: str, train: bool):
+    table = {
+        "CIFAR10": (cifar10_train_transforms, cifar10_test_transforms),
+        "CIFAR100": (cifar100_train_transforms, cifar100_test_transforms),
+        "EMNIST": (femnist_train_transforms, femnist_test_transforms),
+        "ImageNet": (imagenet_train_transforms, imagenet_val_transforms),
+        "Synthetic": (None, None),
+    }
+    tr, te = table.get(dataset_name, (None, None))
+    return tr if train else te

@@ -1,0 +1,117 @@
+"""High-level federated training API.
+
+The reference's user contract (reference cv_train.py:389-390):
+
+    model = FedModel(model, compute_loss_train, args, compute_loss_val)
+    opt   = FedOptimizer(opt, args)
+    ...
+    loss, acc, down, up = model(batch);  opt.step()
+
+Here both wrappers collapse into one object, because there are no processes
+to coordinate — state is explicit and the round is one jitted function:
+
+    learner = FedLearner(module, cfg, loss_train, loss_val, rng, sample_input)
+    metrics = learner.train_round(client_ids, batch, mask)   # one fed round
+    metrics = learner.evaluate(batches)                      # centralized val
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from commefficient_tpu.config import FedConfig
+from commefficient_tpu.federated.round import (
+    FedState, build_eval_step, build_round_step, init_fed_state)
+from commefficient_tpu.utils.params import flatten_params
+from commefficient_tpu.utils.schedules import PiecewiseLinear
+
+
+class FedLearner:
+    def __init__(self, module, cfg: FedConfig, loss_train: Callable,
+                 loss_val: Optional[Callable], rng: jax.Array,
+                 sample_input, lr_schedule: Optional[Callable] = None,
+                 mesh=None, init_params=None):
+        self.module = module
+        init_rng, self.rng = jax.random.split(rng)
+        if init_params is None:
+            variables = module.init(init_rng, sample_input, train=False)
+            init_params = variables["params"]
+        flat, unflatten = flatten_params(init_params)
+        flat = flat.astype(jnp.float32)
+        self.unflatten = unflatten
+        self.cfg = cfg.finalize(flat.shape[0])
+        self.mesh = mesh
+        self.state: FedState = init_fed_state(self.cfg, flat)
+        if mesh is not None:
+            from commefficient_tpu.parallel.mesh import (batch_shardings,
+                                                         shard_state)
+            self.state = shard_state(self.state, self.cfg, mesh)
+            self._batch_sh = batch_shardings(mesh)
+        self._round = build_round_step(loss_train, unflatten, self.cfg,
+                                       mesh=mesh)
+        self._eval = build_eval_step(loss_val or loss_train, unflatten)
+        self.lr_schedule = lr_schedule or (lambda t: cfg.lr_scale)
+        self.rounds_done = 0
+        self.total_download_bytes = 0.0
+        self.total_upload_bytes = 0.0
+
+    @property
+    def params(self):
+        """Current global model as a pytree (for checkpoint/eval exports)."""
+        return self.unflatten(self.state.weights)
+
+    def lr_at(self, t: float) -> float:
+        return float(self.lr_schedule(t))
+
+    def train_round(self, client_ids, batch, mask, epoch_frac=None):
+        """Run one federated round. Host-side metric rollup mirrors
+        run_batches (reference cv_train.py:171-252)."""
+        lr = self.lr_at(self.rounds_done if epoch_frac is None else epoch_frac)
+        self.rng, round_rng = jax.random.split(self.rng)
+        ids = jnp.asarray(client_ids, jnp.int32)
+        cols = tuple(jnp.asarray(t) for t in batch)
+        m = jnp.asarray(mask, jnp.float32)
+        if self.mesh is not None:
+            ids_sh, cols_sh, mask_sh = self._batch_sh
+            ids = jax.device_put(ids, ids_sh)
+            cols = jax.device_put(cols, cols_sh)
+            m = jax.device_put(m, mask_sh)
+        self.state, metrics = self._round(self.state, ids, cols, m,
+                                          lr, round_rng)
+        self.rounds_done += 1
+        out = jax.device_get(metrics)
+        n = max(float(out["num_datapoints"]), 1.0)
+        self.total_download_bytes += float(out["download_bytes"])
+        self.total_upload_bytes += float(out["upload_bytes"])
+        return {
+            "loss": float(out["loss_sum"]) / n,
+            "metrics": np.asarray(out["metric_sums"]) / n,
+            "num_datapoints": n,
+            "download_bytes": float(out["download_bytes"]),
+            "upload_bytes": float(out["upload_bytes"]),
+            "update_l2": float(out["update_l2"]),
+            "lr": lr,
+        }
+
+    def evaluate(self, batches: Iterable):
+        """Centralized validation over an iterable of (batch_tuple, mask)."""
+        loss_sum, metric_sums, n_total = 0.0, None, 0.0
+        for batch, mask in batches:
+            self.rng, eval_rng = jax.random.split(self.rng)
+            out = jax.device_get(self._eval(
+                self.state.weights,
+                tuple(jnp.asarray(t) for t in batch),
+                jnp.asarray(mask, jnp.float32), eval_rng))
+            loss_sum += float(out["loss_sum"])
+            ms = np.asarray(out["metric_sums"])
+            metric_sums = ms if metric_sums is None else metric_sums + ms
+            n_total += float(out["num_datapoints"])
+        n = max(n_total, 1.0)
+        return {"loss": loss_sum / n,
+                "metrics": (metric_sums if metric_sums is not None
+                            else np.zeros(1)) / n,
+                "num_datapoints": n}

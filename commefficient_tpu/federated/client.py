@@ -1,0 +1,219 @@
+"""Client-side local computation: one simulated federated client's step.
+
+Functional port of the reference worker math (reference fed_worker.py:140-335)
+— local SGD gradients with weight decay, gradient clipping, worker-side DP,
+local momentum, local error feedback, local top-k masking, sketching, and the
+FedAvg multi-epoch inner loop — with two structural changes:
+
+* No processes, no queues: one client's step is a pure function; the round
+  vmaps it over sampled clients and XLA shards the vmap across the mesh.
+* Ragged client batches become fixed-shape padded batches with a validity
+  mask (XLA needs static shapes); all sums weight by true counts, matching
+  the reference's weighting by datapoints (fed_worker.py:281-283).
+
+The loss callable contract (set by the entrypoints, like compute_loss_train
+at reference cv_train.py:67-83):
+
+    apply_loss(params_pytree, batch_tuple, rng, train) ->
+        (per_example_loss (B,), per_example_metrics (M, B))
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from commefficient_tpu.config import FedConfig
+from commefficient_tpu.ops.countsketch import CountSketch
+from commefficient_tpu.ops.topk import topk
+
+
+class ClientStepOut(NamedTuple):
+    transmit: jax.Array          # (d,) or (r, c): sum-of-grads scaled
+    velocity: Optional[jax.Array]
+    error: Optional[jax.Array]
+    client_weights: Optional[jax.Array]
+    loss_sum: jax.Array
+    metric_sums: jax.Array
+    num_datapoints: jax.Array
+
+
+def _masked_loss_and_grad(apply_loss, unflatten, w_flat, batch, mask, rng):
+    """Gradient of the *summed* loss over valid examples + summed metrics."""
+
+    def loss_sum_fn(flat):
+        params = unflatten(flat)
+        per_ex_loss, per_ex_metrics = apply_loss(params, batch, rng, True)
+        loss_sum = jnp.sum(per_ex_loss * mask)
+        metric_sums = jnp.sum(per_ex_metrics * mask[None, :], axis=-1)
+        return loss_sum, (loss_sum, metric_sums)
+
+    grads, (loss_sum, metric_sums) = jax.grad(
+        loss_sum_fn, has_aux=True)(w_flat)
+    return grads, loss_sum, metric_sums
+
+
+def _clip_to_norm(vec, max_norm):
+    """Scale down to max_norm if the norm exceeds it (ref utils.py:305-313)."""
+    norm = jnp.linalg.norm(vec)
+    scale = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12), 1.0)
+    return vec * scale
+
+
+def reconstruct_worker_weights(ps_weights, stale_weights, cfg: FedConfig):
+    """topk_down: stale client weights + top-k of the diff
+    (ref get_new_worker_weights, fed_worker.py:232-247)."""
+    diff = ps_weights - stale_weights
+    return stale_weights + topk(diff, cfg.k)
+
+
+def compute_gradient(apply_loss, unflatten, forward_weights, batch, mask,
+                     rng, cfg: FedConfig, sketch: Optional[CountSketch]):
+    """The forward_grad equivalent (ref fed_worker.py:249-335): returns the
+    (possibly sketched) *mean* gradient and summed loss/metrics."""
+    n = jnp.sum(mask)
+    safe_n = jnp.maximum(n, 1.0)
+    grad_sum, loss_sum, metric_sums = _masked_loss_and_grad(
+        apply_loss, unflatten, forward_weights, batch, mask, rng)
+    grad = grad_sum / safe_n
+
+    # gradient clipping on the raw gradient, before weight decay — matches
+    # clip_grad_norm_ placement at ref fed_worker.py:290-292 (non-sketch)
+    if cfg.max_grad_norm is not None and cfg.mode != "sketch":
+        grad = _clip_to_norm(grad, cfg.max_grad_norm)
+
+    # weight decay folded into the gradient (ref utils.py:254-259); divided
+    # by num_workers because every worker adds it and the server sums
+    if cfg.weight_decay != 0:
+        grad = grad + (cfg.weight_decay / cfg.num_workers) * forward_weights
+
+    # worker-side differential privacy (ref fed_worker.py:304-309)
+    if cfg.do_dp:
+        grad = _clip_to_norm(grad, cfg.l2_norm_clip)
+        if cfg.dp_mode == "worker":
+            noise_rng = jax.random.fold_in(rng, 1)
+            grad = grad + (cfg.noise_multiplier *
+                           jnp.sqrt(float(cfg.num_workers)) *
+                           jax.random.normal(noise_rng, grad.shape))
+
+    if cfg.mode == "sketch":
+        g = sketch.sketch_vec(grad)
+        if cfg.max_grad_norm is not None:
+            # sketch-space clip via l2 estimate (ref fed_worker.py:317-319)
+            est = sketch.l2estimate(g)
+            scale = jnp.where(est > cfg.max_grad_norm,
+                              cfg.max_grad_norm / jnp.maximum(est, 1e-12), 1.0)
+            g = g * scale
+    else:
+        g = grad
+
+    return g, loss_sum, metric_sums, n
+
+
+def client_step(apply_loss, unflatten, ps_weights, batch, mask, velocity,
+                error, stale_weights, rng, cfg: FedConfig,
+                sketch: Optional[CountSketch]) -> ClientStepOut:
+    """One non-fedavg client's local step (ref local_step fed_worker.py:184-230)."""
+    if cfg.do_topk_down:
+        forward_weights = reconstruct_worker_weights(
+            ps_weights, stale_weights, cfg)
+        new_stale = forward_weights
+    else:
+        forward_weights = ps_weights
+        new_stale = None
+
+    g, loss_sum, metric_sums, n = compute_gradient(
+        apply_loss, unflatten, forward_weights, batch, mask, rng, cfg, sketch)
+
+    # sum-of-gradients semantics: scale the mean grad back up by the true
+    # batch size so the server can divide by total datapoints (ref :190)
+    g = g * n
+
+    if cfg.local_momentum > 0:
+        velocity = g + cfg.local_momentum * velocity
+        carrier = velocity
+    else:
+        carrier = g
+
+    if cfg.error_type == "local":
+        error = error + carrier
+        to_transmit = error
+    else:
+        to_transmit = carrier
+
+    if cfg.mode == "local_topk":
+        to_transmit = topk(to_transmit, cfg.k)
+        support = to_transmit != 0
+        if cfg.error_type == "local":
+            error = jnp.where(support, 0.0, error)   # error feedback
+        if cfg.local_momentum > 0:
+            velocity = jnp.where(support, 0.0, velocity)  # factor masking
+
+    return ClientStepOut(transmit=to_transmit, velocity=velocity, error=error,
+                         client_weights=new_stale, loss_sum=loss_sum,
+                         metric_sums=metric_sums, num_datapoints=n)
+
+
+def fedavg_client_step(apply_loss, unflatten, ps_weights, batch, mask, lr,
+                       rng, cfg: FedConfig) -> ClientStepOut:
+    """FedAvg: multi-epoch local SGD on this client's whole (padded) dataset,
+    transmitting the weight delta scaled by the client's datapoint count
+    (ref fed_worker.py:61-113) — as a lax.scan over static-shaped chunks.
+
+    Divergence note: the reference derives its per-step lr-decay exponent
+    from the client's actual batch count; with padding, clients smaller than
+    the padded size see fewer *effective* steps but the same decay schedule.
+    Identical when fedavg_lr_decay == 1 (the default).
+    """
+    max_b = mask.shape[0]
+    if cfg.fedavg_batch_size == -1:
+        chunk = max_b
+    else:
+        chunk = min(cfg.fedavg_batch_size, max_b)
+    n_chunks = -(-max_b // chunk)  # ceil
+    pad_to = n_chunks * chunk
+
+    def pad(x):
+        pad_width = [(0, pad_to - max_b)] + [(0, 0)] * (x.ndim - 1)
+        return jnp.pad(x, pad_width)
+
+    batch = tuple(pad(t) for t in batch)
+    mask_p = pad(mask)
+    n_steps = n_chunks * cfg.num_fedavg_epochs
+
+    def body(w, step):
+        b_idx = step % n_chunks
+        start = b_idx * chunk
+        mb = tuple(jax.lax.dynamic_slice_in_dim(t, start, chunk) for t in batch)
+        mmask = jax.lax.dynamic_slice_in_dim(mask_p, start, chunk)
+        g, loss_sum, metric_sums, n = compute_gradient(
+            apply_loss, unflatten, w, mb, mmask,
+            jax.random.fold_in(rng, step), cfg, None)
+        decay = cfg.fedavg_lr_decay ** step
+        # g is already the mean grad over the chunk (ref :98-101 divides)
+        w = w - g * lr * decay * jnp.where(n > 0, 1.0, 0.0)
+        return w, (loss_sum, metric_sums, n)
+
+    final_w, (loss_sums, metric_sums, ns) = jax.lax.scan(
+        body, ps_weights, jnp.arange(n_steps))
+
+    client_n = jnp.sum(mask)
+    transmit = (ps_weights - final_w) * client_n
+    return ClientStepOut(
+        transmit=transmit, velocity=None, error=None, client_weights=None,
+        # metrics summed over all local steps; one epoch over the client's
+        # data contributes each datapoint once per epoch
+        loss_sum=jnp.sum(loss_sums) / cfg.num_fedavg_epochs,
+        metric_sums=jnp.sum(metric_sums, axis=0) / cfg.num_fedavg_epochs,
+        num_datapoints=client_n)
+
+
+def eval_step(apply_loss, unflatten, ps_weights, batch, mask, rng):
+    """Validation forward pass (ref _call_val / compute_grad=False path)."""
+    params = unflatten(ps_weights)
+    per_ex_loss, per_ex_metrics = apply_loss(params, batch, rng, False)
+    return (jnp.sum(per_ex_loss * mask),
+            jnp.sum(per_ex_metrics * mask[None, :], axis=-1),
+            jnp.sum(mask))

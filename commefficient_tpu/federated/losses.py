@@ -1,0 +1,42 @@
+"""Standard loss callables matching the entrypoints' losses.
+
+Contract (see client.py): apply_loss(params, batch_tuple, rng, train)
+-> (per_example_loss (B,), per_example_metrics (M, B)).
+
+Reference equivalents: compute_loss_ce / Correct metric
+(reference cv_train.py:32-83) and the GPT2 LM+MC loss
+(reference gpt2_train.py:77-99).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def make_cv_loss(model):
+    """Cross-entropy + top-1 correctness for image classifiers."""
+
+    def apply_loss(params, batch, rng, train):
+        images, targets = batch
+        logits = model.apply({"params": params}, images, train=train,
+                             rngs={"dropout": rng} if train else None)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, targets)
+        correct = (jnp.argmax(logits, -1) == targets).astype(jnp.float32)
+        return loss, correct[None, :]
+
+    return apply_loss
+
+
+def make_regression_loss(model):
+    """Squared error, for the golden-value toy problems."""
+
+    def apply_loss(params, batch, rng, train):
+        x, y = batch
+        pred = model.apply({"params": params}, x, train=train)
+        loss = jnp.sum((pred - y) ** 2, axis=-1)
+        return loss, jnp.zeros((1, loss.shape[0]))
+
+    return apply_loss

@@ -1,0 +1,44 @@
+"""Model zoo: name-based registry (reference models/__init__.py:1-7,
+utils.py:114-118 introspect ``--model`` choices from the module and
+instantiate via getattr).
+
+All models are flax.linen Modules in NHWC layout (TPU-native). Batch-norm-free
+defaults (plain convs / Fixup / LayerNorm) are preserved from the reference —
+they are load-bearing for federated correctness (no cross-client BN leakage).
+"""
+
+from commefficient_tpu.models.resnet9 import ResNet9
+from commefficient_tpu.models.fixup_resnet9 import FixupResNet9
+from commefficient_tpu.models.fixup_resnet18 import FixupResNet18, ResNet18
+from commefficient_tpu.models.resnets import (
+    ResNetTV, resnet18, resnet34, resnet50, resnet101, resnet152,
+    ResNet101LN, ResNet50LN)
+from commefficient_tpu.models.toy import ToyLinear, TinyMLP
+
+MODEL_REGISTRY = {
+    "ResNet9": ResNet9,
+    "FixupResNet9": FixupResNet9,
+    "FixupResNet18": FixupResNet18,
+    "ResNet18": ResNet18,
+    "ResNet34": resnet34,
+    "ResNet50": resnet50,
+    "ResNet101": resnet101,
+    "ResNet152": resnet152,
+    "ResNet101LN": ResNet101LN,
+    "ResNet50LN": ResNet50LN,
+    "ToyLinear": ToyLinear,
+    "TinyMLP": TinyMLP,
+}
+
+
+def get_model(name: str, **kwargs):
+    if name not in MODEL_REGISTRY:
+        raise ValueError(f"unknown model {name!r}; choices: "
+                         f"{sorted(MODEL_REGISTRY)}")
+    return MODEL_REGISTRY[name](**kwargs)
+
+
+__all__ = ["MODEL_REGISTRY", "get_model", "ResNet9", "FixupResNet9",
+           "FixupResNet18", "ResNet18", "ResNetTV", "resnet18", "resnet34",
+           "resnet50", "resnet101", "resnet152", "ResNet101LN", "ResNet50LN",
+           "ToyLinear", "TinyMLP"]

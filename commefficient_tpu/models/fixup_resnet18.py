@@ -1,0 +1,114 @@
+"""Self-contained Fixup ResNet-18 and a BN ResNet-18 for CIFAR (reference
+models/fixup_resnet18.py:24-218).
+
+Head quirk preserved: the last stage stays at 256 channels and the classifier
+sees concat(avg_pool, max_pool) = 512 features (ref :84, :127-133).
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from commefficient_tpu.models.fixup_resnet9 import _fixup_std, _normal, _scalar
+
+
+class FixupBlock(nn.Module):
+    c_out: int
+    stride: int
+    num_layers: int
+
+    @nn.compact
+    def __call__(self, x):
+        needs_proj = self.stride != 1 or x.shape[-1] != self.c_out
+        if needs_proj:
+            shortcut = nn.Conv(
+                self.c_out, (1, 1), strides=self.stride, use_bias=False,
+                kernel_init=_normal(_fixup_std(self.c_out, 1)))(x)
+        else:
+            shortcut = x
+        b1a = self.param("add1a", _scalar(0.0), (1,))
+        b1b = self.param("add1b", _scalar(0.0), (1,))
+        b2a = self.param("add2a", _scalar(0.0), (1,))
+        b2b = self.param("add2b", _scalar(0.0), (1,))
+        scale = self.param("mul", _scalar(1.0), (1,))
+        std = _fixup_std(self.c_out) * self.num_layers ** -0.5
+        out = nn.Conv(self.c_out, (3, 3), strides=self.stride, padding=1,
+                      use_bias=False, kernel_init=_normal(std))(x + b1a)
+        out = nn.relu(out + b1b)
+        out = nn.Conv(self.c_out, (3, 3), padding=1, use_bias=False,
+                      kernel_init=nn.initializers.zeros)(out + b2a)
+        out = out * scale + b2b
+        return nn.relu(out + shortcut)
+
+
+class _Stem18(nn.Module):
+    """3x3 prep conv + relu shared by both 18-layer CIFAR nets."""
+    fixup: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        init = _normal(_fixup_std(64)) if self.fixup \
+            else nn.initializers.he_normal()
+        return nn.relu(nn.Conv(64, (3, 3), padding=1, use_bias=False,
+                               kernel_init=init)(x))
+
+
+def _dual_pool_head(x):
+    # concat of global avg and max pools (ref :127-133)
+    avg = jnp.mean(x, axis=(1, 2))
+    mx = jnp.max(x, axis=(1, 2))
+    return jnp.concatenate([avg, mx], axis=-1)
+
+
+_STAGES = ((64, 1), (128, 2), (256, 2), (256, 2))
+
+
+class FixupResNet18(nn.Module):
+    num_classes: int = 10
+    num_blocks: tuple = (2, 2, 2, 2)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        num_layers = sum(self.num_blocks)
+        x = _Stem18(fixup=True)(x)
+        for (c, stride), n in zip(_STAGES, self.num_blocks):
+            for i in range(n):
+                x = FixupBlock(c, stride if i == 0 else 1, num_layers)(x)
+        x = _dual_pool_head(x)
+        return nn.Dense(self.num_classes, kernel_init=nn.initializers.zeros,
+                        bias_init=nn.initializers.zeros)(x)
+
+
+class _BNBlock(nn.Module):
+    c_out: int
+    stride: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        out = nn.Conv(self.c_out, (3, 3), strides=self.stride, padding=1,
+                      use_bias=False,
+                      kernel_init=nn.initializers.he_normal())(x)
+        out = nn.relu(nn.BatchNorm(use_running_average=not train)(out))
+        out = nn.Conv(self.c_out, (3, 3), padding=1, use_bias=False,
+                      kernel_init=nn.initializers.he_normal())(out)
+        out = nn.relu(nn.BatchNorm(use_running_average=not train)(out))
+        if self.stride != 1 or x.shape[-1] != self.c_out:
+            x = nn.Conv(self.c_out, (1, 1), strides=self.stride,
+                        use_bias=False,
+                        kernel_init=nn.initializers.he_normal())(x)
+        return out + x
+
+
+class ResNet18(nn.Module):
+    """The reference's CIFAR 'ResNet18' (post-activation blocks despite the
+    PreActBlock name, ref :160-165)."""
+    num_classes: int = 10
+    num_blocks: tuple = (2, 2, 2, 2)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = _Stem18(fixup=False)(x)
+        for (c, stride), n in zip(_STAGES, self.num_blocks):
+            for i in range(n):
+                x = _BNBlock(c, stride if i == 0 else 1)(x, train)
+        x = _dual_pool_head(x)
+        return nn.Dense(self.num_classes)(x)

@@ -1,0 +1,101 @@
+"""BN-free ResNet-9 with Fixup initialization (reference
+models/fixup_resnet9.py:10-91; block structure from the external ``fixup``
+package's FixupBasicBlock).
+
+Fixup details preserved because they are load-bearing for matching accuracy
+curves without normalization (SURVEY.md §7 hard parts):
+* scalar bias before/after each conv, scalar scale after the second conv
+* conv weights ~ N(0, sqrt(2 / (c_out * k * k))), block second conv = 0,
+  residual-branch first conv std scaled by num_layers**-0.5
+* classifier initialized to zero
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+
+def _fixup_std(c_out: int, k: int = 3) -> float:
+    # reference fixup_resnet9.py:58-63: std = sqrt(2 / (out_ch * prod(k)))
+    return float(np.sqrt(2.0 / (c_out * k * k)))
+
+
+def _normal(std):
+    return nn.initializers.normal(stddev=std)
+
+
+def _scalar(value):
+    return nn.initializers.constant(value)
+
+
+def _conv3x3(c_out, std, strides=1):
+    return nn.Conv(c_out, (3, 3), strides=strides, padding=1, use_bias=False,
+                   kernel_init=_normal(std))
+
+
+class FixupBasicBlock(nn.Module):
+    """bias1a -> conv1 -> bias1b -> relu -> bias2a -> conv2 -> *scale
+    -> bias2b, residual add, relu."""
+    c: int
+    num_layers: int  # residual depth for the num_layers**-0.5 init scaling
+
+    @nn.compact
+    def __call__(self, x):
+        b1a = self.param("bias1a", _scalar(0.0), (1,))
+        b1b = self.param("bias1b", _scalar(0.0), (1,))
+        b2a = self.param("bias2a", _scalar(0.0), (1,))
+        b2b = self.param("bias2b", _scalar(0.0), (1,))
+        scale = self.param("scale", _scalar(1.0), (1,))
+        std = _fixup_std(self.c) * self.num_layers ** -0.5
+        out = _conv3x3(self.c, std)(x + b1a)
+        out = nn.relu(out + b1b)
+        out = nn.Conv(self.c, (3, 3), padding=1, use_bias=False,
+                      kernel_init=nn.initializers.zeros)(out + b2a)
+        out = out * scale + b2b
+        return nn.relu(out + x)
+
+
+class FixupLayer(nn.Module):
+    """conv+bias/scale+relu+pool followed by num_blocks FixupBasicBlocks
+    (ref fixup_resnet9.py:10-31)."""
+    c_out: int
+    num_blocks: int
+    total_layers: int
+    pool: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        b1a = self.param("bias1a", _scalar(0.0), (1,))
+        b1b = self.param("bias1b", _scalar(0.0), (1,))
+        scale = self.param("scale", _scalar(1.0), (1,))
+        out = _conv3x3(self.c_out, _fixup_std(self.c_out))(x + b1a)
+        out = nn.relu(out * scale + b1b)
+        if self.pool:
+            out = nn.max_pool(out, (2, 2), strides=(2, 2))
+        for _ in range(self.num_blocks):
+            out = FixupBasicBlock(self.c_out, self.total_layers)(out)
+        return out
+
+
+class FixupResNet9(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        ch = {"prep": 64, "layer1": 128, "layer2": 256, "layer3": 512}
+        num_layers = 2  # two residual blocks total (ref :36)
+        b1a = self.param("bias1a", _scalar(0.0), (1,))
+        b1b = self.param("bias1b", _scalar(0.0), (1,))
+        scale = self.param("scale", _scalar(1.0), (1,))
+        out = _conv3x3(ch["prep"], _fixup_std(ch["prep"]))(x + b1a)
+        out = nn.relu(out * scale + b1b)
+        out = FixupLayer(ch["layer1"], 1, num_layers)(out)
+        out = FixupLayer(ch["layer2"], 0, num_layers)(out)
+        out = FixupLayer(ch["layer3"], 1, num_layers)(out)
+        out = nn.max_pool(out, (4, 4), strides=(4, 4))
+        out = out.reshape((out.shape[0], -1))
+        b2 = self.param("bias2", _scalar(0.0), (1,))
+        out = nn.Dense(self.num_classes,
+                       kernel_init=nn.initializers.zeros,
+                       bias_init=nn.initializers.zeros)(out + b2)
+        return out

@@ -1,0 +1,75 @@
+"""ResNet-9, cifar10-fast style (reference models/resnet9.py:32-149).
+
+Architecture parity with the reference: prep ConvBN(3->64), layer1(64->128)
++pool2, residual, layer2(128->256)+pool2, layer3(256->512)+pool2, residual,
+maxpool4, bias-free linear head, and the load-bearing 0.125 logit scale
+(reference resnet9.py:133 ``weight=0.125``). BatchNorm is optional and off by
+default (reference ``do_batchnorm=False``); convs are bias-free either way.
+
+TPU-first: NHWC layout, he_normal conv init, all static shapes.
+"""
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+_conv_init = nn.initializers.he_normal()
+
+
+class ConvBN(nn.Module):
+    c_out: int
+    do_batchnorm: bool = False
+    pool: bool = False
+    bn_weight_init: float = 1.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(self.c_out, (3, 3), padding=1, use_bias=False,
+                    kernel_init=_conv_init)(x)
+        if self.do_batchnorm:
+            x = nn.BatchNorm(
+                use_running_average=not train, momentum=0.9,
+                scale_init=nn.initializers.constant(self.bn_weight_init),
+            )(x)
+        x = nn.relu(x)
+        if self.pool:
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        return x
+
+
+class Residual(nn.Module):
+    c: int
+    do_batchnorm: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        y = ConvBN(self.c, self.do_batchnorm)(x, train)
+        y = ConvBN(self.c, self.do_batchnorm)(y, train)
+        # reference Residual: x + relu(res2(res1(x))) (resnet9.py:68); relu
+        # is already applied inside ConvBN, so this is x + res2(res1(x))
+        return x + y
+
+
+class ResNet9(nn.Module):
+    num_classes: int = 10
+    do_batchnorm: bool = False
+    logit_weight: float = 0.125
+    channels: Optional[dict] = None  # input channels are inferred from x
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        ch = self.channels or {"prep": 64, "layer1": 128,
+                               "layer2": 256, "layer3": 512}
+        bn = self.do_batchnorm
+        x = ConvBN(ch["prep"], bn)(x, train)
+        x = ConvBN(ch["layer1"], bn, pool=True)(x, train)
+        x = Residual(ch["layer1"], bn)(x, train)
+        x = ConvBN(ch["layer2"], bn, pool=True)(x, train)
+        x = ConvBN(ch["layer3"], bn, pool=True)(x, train)
+        x = Residual(ch["layer3"], bn)(x, train)
+        x = nn.max_pool(x, (4, 4), strides=(4, 4))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(self.num_classes, use_bias=False,
+                     kernel_init=nn.initializers.lecun_normal())(x)
+        return x * self.logit_weight

@@ -1,0 +1,141 @@
+"""torchvision-style ResNets with pluggable normalization (reference
+models/resnets.py:133-309).
+
+Reference modifications preserved:
+* configurable 1-channel stem for 28x28 EMNIST (ref :155)
+* LayerNorm as a BN substitute for federated runs (ref :86-97). The
+  reference hard-codes LN spatial sizes for 28x28 inputs; here LayerNorm
+  normalizes over the channel axis only, which works at any resolution
+  (strictly more capable, and the standard choice for conv LN in JAX).
+* ResNet101LN / ResNet50LN convenience wrappers (ref models/resnet101ln.py).
+
+Norm options: "batch", "layer", "group", "none".
+"""
+
+from functools import partial
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+_he = nn.initializers.he_normal()
+
+
+class _Norm(nn.Module):
+    kind: str = "batch"
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        if self.kind == "batch":
+            return nn.BatchNorm(use_running_average=not train)(x)
+        if self.kind == "layer":
+            return nn.LayerNorm()(x)
+        if self.kind == "group":
+            return nn.GroupNorm(num_groups=32)(x)
+        if self.kind == "none":
+            return x
+        raise ValueError(f"unknown norm {self.kind!r}")
+
+
+class BasicBlock(nn.Module):
+    planes: int
+    stride: int = 1
+    norm: str = "batch"
+    expansion = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        out = nn.Conv(self.planes, (3, 3), strides=self.stride, padding=1,
+                      use_bias=False, kernel_init=_he)(x)
+        out = nn.relu(_Norm(self.norm)(out, train))
+        out = nn.Conv(self.planes, (3, 3), padding=1, use_bias=False,
+                      kernel_init=_he)(out)
+        out = _Norm(self.norm)(out, train)
+        if self.stride != 1 or x.shape[-1] != self.planes:
+            x = nn.Conv(self.planes, (1, 1), strides=self.stride,
+                        use_bias=False, kernel_init=_he)(x)
+            x = _Norm(self.norm)(x, train)
+        return nn.relu(out + x)
+
+
+class Bottleneck(nn.Module):
+    planes: int
+    stride: int = 1
+    norm: str = "batch"
+    expansion = 4
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        width = self.planes
+        out_ch = self.planes * self.expansion
+        out = nn.Conv(width, (1, 1), use_bias=False, kernel_init=_he)(x)
+        out = nn.relu(_Norm(self.norm)(out, train))
+        out = nn.Conv(width, (3, 3), strides=self.stride, padding=1,
+                      use_bias=False, kernel_init=_he)(out)
+        out = nn.relu(_Norm(self.norm)(out, train))
+        out = nn.Conv(out_ch, (1, 1), use_bias=False, kernel_init=_he)(out)
+        # zero-init the residual branch's last norm scale (the standard
+        # torchvision zero_init_residual trick is optional there; plain here)
+        out = _Norm(self.norm)(out, train)
+        if self.stride != 1 or x.shape[-1] != out_ch:
+            x = nn.Conv(out_ch, (1, 1), strides=self.stride, use_bias=False,
+                        kernel_init=_he)(x)
+            x = _Norm(self.norm)(x, train)
+        return nn.relu(out + x)
+
+
+class ResNetTV(nn.Module):
+    """ImageNet-style ResNet: 7x7/2 stem + maxpool + 4 stages + avgpool."""
+    block: type = Bottleneck
+    layers: Sequence[int] = (3, 4, 6, 3)
+    num_classes: int = 1000
+    norm: str = "batch"
+    # input channels are inferred from x by flax Conv — the reference
+    # hard-codes a 1-channel stem for EMNIST (ref :155); here 28x28x1
+    # inputs just work
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(64, (7, 7), strides=2, padding=3, use_bias=False,
+                    kernel_init=_he)(x)
+        x = nn.relu(_Norm(self.norm)(x, train))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        planes = 64
+        for stage, n in enumerate(self.layers):
+            for i in range(n):
+                stride = 2 if (stage > 0 and i == 0) else 1
+                x = self.block(planes, stride, self.norm)(x, train)
+            planes *= 2
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+def resnet18(**kw):
+    return ResNetTV(block=BasicBlock, layers=(2, 2, 2, 2), **kw)
+
+
+def resnet34(**kw):
+    return ResNetTV(block=BasicBlock, layers=(3, 4, 6, 3), **kw)
+
+
+def resnet50(**kw):
+    return ResNetTV(block=Bottleneck, layers=(3, 4, 6, 3), **kw)
+
+
+def resnet101(**kw):
+    return ResNetTV(block=Bottleneck, layers=(3, 4, 23, 3), **kw)
+
+
+def resnet152(**kw):
+    return ResNetTV(block=Bottleneck, layers=(3, 8, 36, 3), **kw)
+
+
+def ResNet101LN(**kw):
+    """ResNet-101 with LayerNorm (ref models/resnet101ln.py:7-13)."""
+    kw.setdefault("norm", "layer")
+    return resnet101(**kw)
+
+
+def ResNet50LN(**kw):
+    kw.setdefault("norm", "layer")
+    return resnet50(**kw)

@@ -1,0 +1,27 @@
+"""Tiny models for golden-value tests (reference unit_test.py:16-26 uses a
+bias-free torch.nn.Linear the same way)."""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ToyLinear(nn.Module):
+    """y = w . x, no bias — the unit-test model."""
+    features: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        return nn.Dense(self.features, use_bias=False,
+                        kernel_init=nn.initializers.zeros)(x)
+
+
+class TinyMLP(nn.Module):
+    """Small MLP classifier for fast end-to-end federated tests."""
+    num_classes: int = 10
+    hidden: int = 32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.hidden)(x))
+        return nn.Dense(self.num_classes)(x)

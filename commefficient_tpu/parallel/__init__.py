@@ -1,0 +1,5 @@
+from commefficient_tpu.parallel.mesh import (
+    make_mesh, fed_state_shardings, batch_shardings, shard_state)
+
+__all__ = ["make_mesh", "fed_state_shardings", "batch_shardings",
+           "shard_state"]

@@ -1,0 +1,83 @@
+"""Device mesh + sharding layout for the federated round.
+
+This replaces the reference's process topology (1 PS process + N worker GPU
+processes wired by shm queues and a localhost NCCL group, reference
+fed_aggregator.py:131-164) with a ``jax.sharding.Mesh`` carrying a single
+``clients`` axis:
+
+* sampled-client batches and per-client state rows are sharded along
+  ``clients`` — each chip simulates W/n_chips clients per round, the analog
+  of each worker GPU sequentially simulating num_workers/n_gpus clients
+  (ref fed_aggregator.py:230-237)
+* global weights and server optimizer state are replicated
+* the cross-device reduce of transmitted gradients is whatever XLA inserts
+  for ``sum`` over the sharded axis — psum over ICI, the NCCL-reduce analog
+  (ref fed_worker.py:138)
+
+Multi-host: build the mesh over ``jax.devices()`` after
+``jax.distributed.initialize()``; the layout is unchanged (DCN slips in
+between hosts automatically).
+
+A ``seq`` axis for sequence/context parallelism (ring attention) composes
+with this: mesh ("clients", "seq"), batches sharded on both axes. The CV
+path leaves seq=1.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from commefficient_tpu.config import FedConfig
+from commefficient_tpu.federated.state import ClientState, ServerOptState
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = "clients",
+              seq: int = 1) -> Mesh:
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    if n > len(devs):
+        raise ValueError(f"asked for {n} devices, have {len(devs)}")
+    if seq > 1:
+        if n % seq:
+            raise ValueError("n_devices must be divisible by seq")
+        arr = np.array(devs[:n]).reshape(n // seq, seq)
+        return Mesh(arr, (axis, "seq"))
+    return Mesh(np.array(devs[:n]), (axis,))
+
+
+def _ns(mesh, *spec):
+    return NamedSharding(mesh, P(*spec))
+
+
+def fed_state_shardings(cfg: FedConfig, mesh: Mesh, axis: str = "clients"):
+    """Sharding pytree matching FedState (see round.FedState)."""
+    from commefficient_tpu.federated.round import FedState
+    rep = _ns(mesh)
+    row = _ns(mesh, axis)
+    clients = ClientState(
+        velocities=row if cfg.needs_velocity_state else None,
+        errors=row if cfg.needs_error_state else None,
+        weights=row if cfg.needs_client_weights else None,
+    )
+    return FedState(
+        weights=rep,
+        opt=ServerOptState(Vvelocity=rep, Verror=rep),
+        clients=clients,
+        round_idx=rep,
+        last_changed=rep,
+        client_last_round=row,
+    )
+
+
+def batch_shardings(mesh: Mesh, axis: str = "clients"):
+    """(ids, cols-prefix, mask) shardings: worker axis over the mesh."""
+    worker0 = _ns(mesh, axis)
+    return worker0, worker0, worker0
+
+
+def shard_state(state, cfg: FedConfig, mesh: Mesh):
+    return jax.device_put(state, fed_state_shardings(cfg, mesh))

@@ -1,0 +1,77 @@
+"""CLI flag surface (reference utils.py:102-230 parse_args).
+
+Flag-name parity with the reference where the concept survives; flags tied
+to the process/NCCL machinery (--port, --num_devices, --share_ps_gpu,
+--*_dataloader_workers) are gone — the mesh replaces them (--mesh).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from commefficient_tpu.config import DP_MODES, ERROR_TYPES, MODES, FedConfig
+from commefficient_tpu.models import MODEL_REGISTRY
+
+
+def build_parser(default_lr: float = 0.4) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser()
+    # meta
+    p.add_argument("--test", action="store_true", dest="do_test")
+    p.add_argument("--mode", choices=MODES, default="sketch")
+    p.add_argument("--seed", type=int, default=21)
+    p.add_argument("--tensorboard", dest="use_tensorboard",
+                   action="store_true")
+    # model/data
+    p.add_argument("--model", default="ResNet9",
+                   choices=sorted(MODEL_REGISTRY))
+    p.add_argument("--dataset_name", default="Synthetic",
+                   choices=["CIFAR10", "CIFAR100", "EMNIST", "ImageNet",
+                            "Synthetic", "PERSONA"])
+    p.add_argument("--dataset_dir", default="./dataset")
+    p.add_argument("--batchnorm", action="store_true", dest="do_batchnorm")
+    p.add_argument("--nan_threshold", type=float, default=999)
+    p.add_argument("--checkpoint", action="store_true", dest="do_checkpoint")
+    p.add_argument("--checkpoint_path", default="./checkpoint")
+    p.add_argument("--finetune", action="store_true", dest="do_finetune")
+    p.add_argument("--finetune_path", default="./finetune")
+    # compression
+    p.add_argument("--k", type=int, default=50000)
+    p.add_argument("--num_cols", type=int, default=500000)
+    p.add_argument("--num_rows", type=int, default=5)
+    p.add_argument("--num_blocks", type=int, default=20)
+    p.add_argument("--topk_down", action="store_true", dest="do_topk_down")
+    # optimization
+    p.add_argument("--local_momentum", type=float, default=0.0)
+    p.add_argument("--virtual_momentum", type=float, default=0.0)
+    p.add_argument("--weight_decay", type=float, default=5e-4)
+    p.add_argument("--num_epochs", type=float, default=24)
+    p.add_argument("--num_fedavg_epochs", type=int, default=1)
+    p.add_argument("--fedavg_batch_size", type=int, default=-1)
+    p.add_argument("--fedavg_lr_decay", type=float, default=1.0)
+    p.add_argument("--error_type", choices=ERROR_TYPES, default="none")
+    p.add_argument("--lr_scale", type=float, default=default_lr)
+    p.add_argument("--pivot_epoch", type=float, default=5)
+    p.add_argument("--max_grad_norm", type=float, default=None)
+    # federated dimensions + mesh
+    p.add_argument("--num_clients", type=int, default=None,
+                   help="None = the dataset's natural partition count")
+    p.add_argument("--num_workers", type=int, default=1)
+    p.add_argument("--local_batch_size", type=int, default=8)
+    p.add_argument("--valid_batch_size", type=int, default=8)
+    p.add_argument("--microbatch_size", type=int, default=-1)
+    p.add_argument("--iid", action="store_true", dest="do_iid")
+    p.add_argument("--mesh", type=str, default="",
+                   help="mesh shape as 'clients=N' (default: all devices)")
+    # DP
+    p.add_argument("--dp", action="store_true", dest="do_dp")
+    p.add_argument("--dp_mode", choices=DP_MODES, default="worker")
+    p.add_argument("--l2_norm_clip", type=float, default=1.0)
+    p.add_argument("--noise_multiplier", type=float, default=0.0)
+    return p
+
+
+def args_to_config(args, **overrides) -> FedConfig:
+    fields = set(FedConfig.__dataclass_fields__)
+    kwargs = {k: v for k, v in vars(args).items() if k in fields}
+    kwargs.update(overrides)
+    return FedConfig(**kwargs)

@@ -1,0 +1,144 @@
+"""CV training entrypoint (reference cv_train.py:85-421).
+
+    python -m commefficient_tpu.training.cv --mode sketch \
+        --dataset_name CIFAR10 --model ResNet9 ...
+
+Structure parity: epoch loop over federated rounds, piecewise-linear LR
+through a pivot epoch, NaN abort, TableLogger console rows, communication
+byte rollup, end-of-training checkpoint. Smoke mode (``--test``) runs one
+round + one val batch on a shrunken model, the plumbing test the reference
+implements with fake gradients (ref fed_worker.py:117-122, cv_train.py:329-336).
+"""
+
+from __future__ import annotations
+
+import math
+import sys
+
+import jax
+import numpy as np
+
+from commefficient_tpu.data import FedBatcher, fed_datasets, val_batches
+from commefficient_tpu.data.transforms import get_transforms
+from commefficient_tpu.federated.api import FedLearner
+from commefficient_tpu.federated.losses import make_cv_loss
+from commefficient_tpu.models import get_model
+from commefficient_tpu.training.args import args_to_config, build_parser
+from commefficient_tpu.utils.logging import TableLogger, Timer
+from commefficient_tpu.utils.schedules import cifar_lr_schedule
+
+DATASET_CLASSES = {"CIFAR10": 10, "CIFAR100": 100, "EMNIST": 62,
+                   "ImageNet": 1000, "Synthetic": 10}
+DATASET_CHANNELS = {"EMNIST": 1}
+
+
+def make_dataset(args, train: bool):
+    cls = fed_datasets[args.dataset_name]
+    # num_clients None => the dataset's natural partition (ref utils.py:173
+    # has no default; FedModel falls back to dataset client counts)
+    kw = dict(dataset_dir=args.dataset_dir, do_iid=args.do_iid,
+              num_clients=args.num_clients, train=train,
+              transform=get_transforms(args.dataset_name, train),
+              seed=args.seed)
+    if args.dataset_name == "Synthetic":
+        kw.update(per_class=64 if args.do_test else 512)
+    return cls(**kw)
+
+
+def build_learner(args, sample_input, num_classes, channels, mesh=None):
+    cfg = args_to_config(args, num_classes=num_classes,
+                         num_channels=channels,
+                         num_clients=args.num_clients)
+    model_kw = dict(num_classes=num_classes)
+    if args.model in ("ResNet9",):
+        model_kw["do_batchnorm"] = args.do_batchnorm
+    # input channel count is inferred by flax from the sample input; no
+    # per-model stem flag needed (1-channel EMNIST just works)
+    model = get_model(args.model, **model_kw)
+    loss = make_cv_loss(model)
+    sched = cifar_lr_schedule(args.lr_scale, args.pivot_epoch,
+                              args.num_epochs)
+    return FedLearner(model, cfg, loss, loss, jax.random.PRNGKey(args.seed),
+                      sample_input, lr_schedule=sched, mesh=mesh)
+
+
+def train(args, mesh=None, max_rounds=None, log=True):
+    train_set = make_dataset(args, train=True)
+    val_set = make_dataset(args, train=False)
+    args.num_clients = train_set.num_clients
+    num_classes = (train_set.num_classes
+                   if hasattr(train_set, "num_classes")
+                   else DATASET_CLASSES[args.dataset_name])
+    channels = DATASET_CHANNELS.get(args.dataset_name, 3)
+
+    batcher = FedBatcher(train_set, args.num_workers, args.local_batch_size,
+                         seed=args.seed)
+    ids0, cols0, mask0 = next(iter(batcher.epoch()))
+    learner = build_learner(args, cols0[0][0][:1], num_classes, channels,
+                            mesh=mesh)
+
+    table = TableLogger() if log else None
+    timer = Timer()
+    spe = batcher.steps_per_epoch()
+    total_rounds = 0
+    for epoch in range(int(math.ceil(args.num_epochs))):
+        epoch_metrics = []
+        for ids, cols, mask in batcher.epoch():
+            frac = total_rounds / max(spe, 1)
+            out = learner.train_round(ids, cols, mask, epoch_frac=frac)
+            total_rounds += 1
+            epoch_metrics.append(out)
+            if not math.isfinite(out["loss"]) or \
+                    out["loss"] > args.nan_threshold:
+                print(f"NaN/divergent loss ({out['loss']}); aborting "
+                      f"(threshold {args.nan_threshold})")
+                return learner, {"aborted": True, "loss": out["loss"]}
+            if args.do_test or (max_rounds and total_rounds >= max_rounds):
+                break
+        train_time = timer()
+        val = learner.evaluate(val_batches(val_set, args.valid_batch_size))
+        val_time = timer()
+        mean = lambda k: float(np.mean([m[k] for m in epoch_metrics]))
+        row = {
+            "epoch": epoch + 1,
+            "lr": epoch_metrics[-1]["lr"],
+            "train_loss": mean("loss"),
+            "train_acc": float(np.mean(
+                [m["metrics"][0] for m in epoch_metrics])),
+            "train_time": train_time,
+            "test_loss": val["loss"],
+            "test_acc": float(val["metrics"][0]),
+            "test_time": val_time,
+            "down (MiB)": learner.total_download_bytes / 2**20,
+            "up (MiB)": learner.total_upload_bytes / 2**20,
+            "total_time": timer.total_time,
+        }
+        if table:
+            table.append(row)
+        if args.do_test or (max_rounds and total_rounds >= max_rounds):
+            break
+
+    if args.do_checkpoint:
+        from commefficient_tpu.utils.checkpoint import save_checkpoint
+        save_checkpoint(args.checkpoint_path, learner, args.model)
+    return learner, row
+
+
+def main(argv=None):
+    parser = build_parser(default_lr=0.4)
+    args = parser.parse_args(argv)
+    if args.do_test:
+        # shrink everything (ref cv_train.py:329-336): tiny sketch, 1 round
+        args.k = min(args.k, 10)
+        args.num_cols = min(args.num_cols, 100)
+        args.num_rows = min(args.num_rows, 1)
+        args.num_epochs = 1
+    np.random.seed(args.seed)
+    _, final = train(args)
+    print("final:", {k: round(v, 4) if isinstance(v, float) else v
+                     for k, v in final.items()})
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

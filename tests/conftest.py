@@ -1,12 +1,14 @@
 """Test harness config: run everything on a virtual 8-device CPU mesh.
 
-Must set XLA flags before jax is imported anywhere (SURVEY.md §4: simulated
-multi-client tests on CPU via --xla_force_host_platform_device_count).
+XLA_FLAGS must be set before jax initializes its backends (SURVEY.md §4:
+simulated multi-client tests on CPU via
+--xla_force_host_platform_device_count). NOTE: this environment pins
+JAX_PLATFORMS=axon via a sitecustomize hook, so the env var cannot force CPU
+— only jax.config.update("jax_platforms", ...) works.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -14,4 +16,10 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
+
+
+def pytest_sessionstart(session):
+    assert jax.devices()[0].platform == "cpu", (
+        "tests must run on CPU; got " + str(jax.devices()))

@@ -1,0 +1,98 @@
+import os
+
+import numpy as np
+import pytest
+
+from commefficient_tpu.data import FedBatcher, FedSampler, SyntheticCV, val_batches
+from commefficient_tpu.data.transforms import (cifar10_train_transforms,
+                                               get_transforms)
+
+
+@pytest.fixture
+def ds(tmp_path):
+    return SyntheticCV(dataset_dir=str(tmp_path / "syn"), num_classes=4,
+                       per_class=10, num_val=16, image_size=8, channels=3)
+
+
+def test_synthetic_partition(ds):
+    assert ds.num_clients == 4
+    assert len(ds) == 40
+    np.testing.assert_array_equal(ds.data_per_client, [10, 10, 10, 10])
+    imgs, targets = ds.get_flat_batch(np.array([0, 10, 25]))
+    np.testing.assert_array_equal(targets, [0, 1, 2])  # class == client
+
+
+def test_synthetic_determinism(tmp_path):
+    a = SyntheticCV(dataset_dir=str(tmp_path / "a"), num_classes=2,
+                    per_class=5, image_size=8)
+    b = SyntheticCV(dataset_dir=str(tmp_path / "b"), num_classes=2,
+                    per_class=5, image_size=8)
+    ia, _ = a.get_flat_batch(np.array([3]))
+    ib, _ = b.get_flat_batch(np.array([3]))
+    np.testing.assert_array_equal(ia, ib)
+
+
+def test_iid_overlay(tmp_path):
+    ds = SyntheticCV(dataset_dir=str(tmp_path / "s"), num_classes=4,
+                     per_class=10, image_size=8, do_iid=True, num_clients=8)
+    assert ds.num_clients == 8
+    assert np.sum(ds.data_per_client) == 40
+    # iid clients mix classes: fetch client 0's slice and check class variety
+    start, end = ds.client_slices()[0]
+    _, targets = ds.get_flat_batch(np.arange(start, end))
+    assert len(np.unique(targets)) > 1
+
+
+def test_sampler_exhausts_each_epoch(ds):
+    sampler = FedSampler(ds, num_workers=2, local_batch_size=4, seed=0)
+    seen = 0
+    for round_batches in sampler.epoch():
+        assert len(round_batches) <= 2
+        for cid, idxs in round_batches:
+            seen += len(idxs)
+            assert len(idxs) <= 4
+    assert seen == len(ds)
+
+
+def test_sampler_whole_client_mode(ds):
+    sampler = FedSampler(ds, num_workers=2, local_batch_size=-1, seed=0)
+    rounds = list(sampler.epoch())
+    # each client appears exactly once with its whole dataset
+    seen_clients = [cid for r in rounds for cid, _ in r]
+    assert sorted(seen_clients) == [0, 1, 2, 3]
+    for r in rounds:
+        for cid, idxs in r:
+            assert len(idxs) == 10
+
+
+def test_batcher_shapes_and_mask(ds):
+    batcher = FedBatcher(ds, num_workers=2, local_batch_size=4, seed=1)
+    for ids, cols, mask in batcher.epoch():
+        assert ids.shape == (2,)
+        assert cols[0].shape == (2, 4, 8, 8, 3)
+        assert cols[1].shape == (2, 4)
+        assert mask.shape == (2, 4)
+        # all valid rows carry the client's class as target
+        for w in range(2):
+            valid = mask[w] > 0
+            assert np.all(cols[1][w][valid] == ids[w])
+
+
+def test_val_batches(tmp_path):
+    ds = SyntheticCV(dataset_dir=str(tmp_path / "v"), num_classes=4,
+                     per_class=4, num_val=10, image_size=8, train=False)
+    batches = list(val_batches(ds, batch_size=4))
+    assert len(batches) == 3
+    (cols, mask) = batches[-1]
+    assert mask.sum() == 2  # 10 = 4+4+2
+    assert cols[0].shape == (4, 8, 8, 3)
+
+
+def test_transforms_normalize_and_augment():
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 255, (4, 32, 32, 3)).astype(np.uint8)
+    cols = cifar10_train_transforms([imgs, np.zeros(4)], rng)
+    assert cols[0].shape == (4, 32, 32, 3)
+    assert abs(cols[0].mean()) < 2.0  # roughly standardized
+    assert get_transforms("CIFAR10", train=False) is not None
+    assert get_transforms("Synthetic", train=True) is None

@@ -1,0 +1,79 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from commefficient_tpu.models import (MODEL_REGISTRY, FixupResNet9,
+                                      FixupResNet18, ResNet9, get_model)
+
+
+def n_params(params):
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+
+
+def init_fwd(model, shape=(2, 32, 32, 3)):
+    x = jnp.zeros(shape)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    out = model.apply(variables, x, train=False,
+                      mutable=list(variables.keys() - {"params"}))
+    logits = out[0] if isinstance(out, tuple) else out
+    return variables["params"], logits
+
+
+def test_resnet9_shape_and_size():
+    params, logits = init_fwd(ResNet9())
+    assert logits.shape == (2, 10)
+    # cifar10-fast ResNet-9 without BN: 6,568,640 weights (the oft-quoted
+    # 6,573,120 includes the 4,480 BatchNorm scale/bias params)
+    assert n_params(params) == 6_568_640
+
+
+def test_resnet9_logit_scale():
+    # doubling the head weight doubles logits only through the 0.125 scale:
+    # just check logits are small at init relative to pre-scale
+    model = ResNet9()
+    x = jnp.ones((1, 32, 32, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    base = model.apply(variables, x, train=False)
+    noscale = ResNet9(logit_weight=1.0).apply(variables, x, train=False)
+    np.testing.assert_allclose(np.asarray(base) * 8.0, np.asarray(noscale),
+                               rtol=1e-5)
+
+
+def test_fixup_resnet9_zero_residual_and_head():
+    params, logits = init_fwd(FixupResNet9())
+    # zero-init classifier => zero logits at init (Fixup property)
+    np.testing.assert_allclose(np.asarray(logits), 0.0)
+
+
+def test_fixup_resnet18_forward():
+    params, logits = init_fwd(FixupResNet18())
+    assert logits.shape == (2, 10)
+    np.testing.assert_allclose(np.asarray(logits), 0.0)
+
+
+@pytest.mark.parametrize("name,shape", [
+    ("ResNet18", (2, 32, 32, 3)),
+    ("ResNet9", (2, 32, 32, 3)),
+    ("ResNet50LN", (2, 64, 64, 3)),
+])
+def test_registry_models_forward(name, shape):
+    model = get_model(name)
+    kwargs = {}
+    x = jnp.zeros(shape)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    out = model.apply(variables, x, train=False)
+    assert out.shape[0] == 2
+
+
+def test_emnist_single_channel_stem():
+    model = get_model("ResNet101LN", num_classes=62)
+    x = jnp.zeros((1, 28, 28, 1))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (1, 62)
+
+
+def test_unknown_model_raises():
+    with pytest.raises(ValueError, match="unknown model"):
+        get_model("ResNet9000")

@@ -1,0 +1,243 @@
+"""End-to-end tests of the jitted federated round.
+
+Golden trajectories use the reference toy problem (y = w*x, x = [0..3],
+targets y = x; unit_test.py:79-110 style): aggregated mean gradient is
+7*(w-1), so with lr=0.02: w1 = 0.14; with virtual momentum 0.9, w2 = 0.3864;
+without momentum, w2 = 0.2604.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from commefficient_tpu.config import FedConfig
+from commefficient_tpu.federated.api import FedLearner
+from commefficient_tpu.federated.losses import (make_cv_loss,
+                                                make_regression_loss)
+from commefficient_tpu.models import TinyMLP, ToyLinear
+
+X = np.asarray([[0.0], [1.0], [2.0], [3.0]], np.float32)
+Y = X.copy()
+
+
+def toy_learner(cfg, num_workers=1, **kw):
+    model = ToyLinear()
+    return FedLearner(model, cfg, make_regression_loss(model), None,
+                      jax.random.PRNGKey(0), X[:1], **kw)
+
+
+def one_worker_batch():
+    ids = np.array([0])
+    batch = (X[None], Y[None])           # (W=1, B=4, 1)
+    mask = np.ones((1, 4), np.float32)
+    return ids, batch, mask
+
+
+def two_worker_batch():
+    ids = np.array([0, 1])
+    batch = (X.reshape(2, 2, 1), Y.reshape(2, 2, 1))
+    mask = np.ones((2, 2), np.float32)
+    return ids, batch, mask
+
+
+def weight(learner):
+    return float(learner.state.weights[0])
+
+
+def test_uncompressed_golden_trajectory():
+    cfg = FedConfig(mode="uncompressed", virtual_momentum=0.9,
+                    local_momentum=0, error_type="none", weight_decay=0,
+                    num_workers=1, lr_scale=0.02)
+    ln = toy_learner(cfg)
+    ids, batch, mask = one_worker_batch()
+    out = ln.train_round(ids, batch, mask)
+    assert weight(ln) == pytest.approx(0.14, abs=1e-6)
+    # per-datapoint mean loss at w=0: mean((0*x - x)^2) = mean([0,1,4,9]) = 3.5
+    assert out["loss"] == pytest.approx(3.5, abs=1e-5)
+    ln.train_round(ids, batch, mask)
+    assert weight(ln) == pytest.approx(0.3864, abs=1e-5)
+
+
+def test_two_workers_same_trajectory():
+    # splitting the batch across workers must not change the math
+    # (sum of transmits / total datapoints, ref fed_aggregator.py:332)
+    cfg = FedConfig(mode="uncompressed", virtual_momentum=0.9,
+                    local_momentum=0, error_type="none", weight_decay=0,
+                    num_workers=2, lr_scale=0.02, num_clients=2)
+    ln = toy_learner(cfg)
+    ids, batch, mask = two_worker_batch()
+    ln.train_round(ids, batch, mask)
+    assert weight(ln) == pytest.approx(0.14, abs=1e-6)
+    ln.train_round(ids, batch, mask)
+    assert weight(ln) == pytest.approx(0.3864, abs=1e-5)
+
+
+def test_padding_invariance():
+    # padded rows with mask=0 must not change anything
+    cfg = FedConfig(mode="uncompressed", virtual_momentum=0.9,
+                    local_momentum=0, error_type="none", weight_decay=0,
+                    num_workers=1, lr_scale=0.02)
+    ln = toy_learner(cfg)
+    xpad = np.concatenate([X, np.full((2, 1), 77.0, np.float32)])[None]
+    ypad = np.concatenate([Y, np.zeros((2, 1), np.float32)])[None]
+    mask = np.asarray([[1, 1, 1, 1, 0, 0]], np.float32)
+    out = ln.train_round(np.array([0]), (xpad, ypad), mask)
+    assert weight(ln) == pytest.approx(0.14, abs=1e-6)
+    assert out["num_datapoints"] == 4.0
+    assert out["loss"] == pytest.approx(3.5, abs=1e-5)
+
+
+def test_fedavg_golden():
+    # 1 epoch, whole-dataset batch: transmit = lr*mean_grad*n; aggregated
+    # update = lr*mean_grad -> w1 = 0.14 (ref fed_worker.py:61-113)
+    cfg = FedConfig(mode="fedavg", virtual_momentum=0.0, local_momentum=0,
+                    error_type="none", weight_decay=0, num_workers=1,
+                    lr_scale=0.02, local_batch_size=-1)
+    ln = toy_learner(cfg)
+    ids, batch, mask = one_worker_batch()
+    ln.train_round(ids, batch, mask)
+    assert weight(ln) == pytest.approx(0.14, abs=1e-6)
+
+
+def test_fedavg_multi_step_local_sgd():
+    # fedavg_batch_size=2 -> two sequential local SGD steps per round
+    cfg = FedConfig(mode="fedavg", virtual_momentum=0.0, local_momentum=0,
+                    error_type="none", weight_decay=0, num_workers=1,
+                    lr_scale=0.02, local_batch_size=-1, fedavg_batch_size=2)
+    ln = toy_learner(cfg)
+    ids, batch, mask = one_worker_batch()
+    ln.train_round(ids, batch, mask)
+    # local: w=0; mb1 grad = mean 2(w-1)x^2 over x=[0,1] = (w-1); w=.02*1=0.02
+    # mb2 grad = mean over x=[2,3] = 13(w-1) = -12.74; w = .02+.2548 = .2748
+    # transmit = (0 - .2748)*4; agg = -.2748; w1 = .2748
+    assert weight(ln) == pytest.approx(0.2748, abs=1e-5)
+
+
+def test_true_topk_full_k_equals_plain_sgd():
+    cfg = FedConfig(mode="true_topk", error_type="virtual", k=1,
+                    virtual_momentum=0.9, local_momentum=0, weight_decay=0,
+                    num_workers=1, lr_scale=0.02)
+    ln = toy_learner(cfg)
+    ids, batch, mask = one_worker_batch()
+    ln.train_round(ids, batch, mask)
+    ln.train_round(ids, batch, mask)
+    # factor masking wipes momentum each round (d=1=k): plain SGD
+    assert weight(ln) == pytest.approx(0.2604, abs=1e-5)
+
+
+def test_local_momentum_and_error_state_threading():
+    d_clients = 4
+    cfg = FedConfig(mode="local_topk", error_type="local", k=1,
+                    virtual_momentum=0.0, local_momentum=0.9, weight_decay=0,
+                    num_workers=1, num_clients=d_clients, lr_scale=0.02)
+    ln = toy_learner(cfg)
+    ids, batch, mask = one_worker_batch()
+    assert ln.state.clients.velocities is not None
+    assert ln.state.clients.errors is not None
+    ln.train_round(ids, batch, mask)
+    vels = np.asarray(ln.state.clients.velocities)
+    # client 0 participated; with k=d=1 masking zeroed its velocity again,
+    # but non-participants must be untouched zeros too — check scatter shape
+    assert vels.shape == (d_clients, 1)
+    # run a second round with client 2 and check client 0's rows preserved
+    ln.train_round(np.array([2]), batch, mask)
+    assert np.all(np.asarray(ln.state.clients.errors)[1] == 0)
+
+
+def test_byte_accounting_uncompressed_vs_topk():
+    # round 1: nothing changed yet -> 0 download. After an uncompressed
+    # round every weight changed -> next participant downloads 4*d bytes.
+    d = None
+    cfg = FedConfig(mode="uncompressed", virtual_momentum=0.0,
+                    local_momentum=0, error_type="none", weight_decay=0,
+                    num_workers=1, num_clients=2, lr_scale=0.02)
+    ln = toy_learner(cfg)
+    d = ln.cfg.grad_size
+    ids, batch, mask = one_worker_batch()
+    out1 = ln.train_round(ids, batch, mask)
+    assert out1["download_bytes"] == 0.0
+    assert out1["upload_bytes"] == 4.0 * d
+    out2 = ln.train_round(np.array([1]), batch, mask)
+    assert out2["download_bytes"] == 4.0 * d
+
+
+def test_sketch_end_to_end_learns():
+    # TinyMLP on a linearly-separable synthetic task, sketched FetchSGD
+    rng = np.random.RandomState(0)
+    Xs = rng.randn(64, 8).astype(np.float32)
+    ys = (Xs[:, 0] > 0).astype(np.int32)
+    model = TinyMLP(num_classes=2, hidden=16)
+    cfg = FedConfig(mode="sketch", error_type="virtual", virtual_momentum=0.9,
+                    local_momentum=0, weight_decay=0, num_workers=4,
+                    num_clients=4, lr_scale=0.1, k=50, num_rows=5,
+                    num_cols=2000)
+    ln = FedLearner(model, cfg, make_cv_loss(model), None,
+                    jax.random.PRNGKey(1), Xs[:1])
+    ids = np.arange(4)
+    batch = (Xs.reshape(4, 16, 8), ys.reshape(4, 16))
+    mask = np.ones((4, 16), np.float32)
+    first = ln.train_round(ids, batch, mask)
+    for _ in range(40):
+        last = ln.train_round(ids, batch, mask)
+    assert last["loss"] < first["loss"] * 0.5
+    assert last["metrics"][0] > 0.9  # accuracy
+    assert last["upload_bytes"] == 4.0 * 4 * 5 * 2000
+
+
+def test_padded_worker_slots_are_inert():
+    # Epoch-tail rounds have fewer real clients than num_workers; padded
+    # slots (all-zero mask, id aliasing 0) must not transmit, must not
+    # write state rows, and must not count in byte accounting.
+    cfg = FedConfig(mode="local_topk", error_type="local", k=1,
+                    virtual_momentum=0.0, local_momentum=0.9, weight_decay=0,
+                    num_workers=2, num_clients=4, lr_scale=0.02)
+    ln = toy_learner(cfg)
+    ids, batch, mask = one_worker_batch()
+    # round 1: client 0 participates alone, accumulating error/velocity rows
+    ln.train_round(ids, batch, mask)
+    err0 = np.asarray(ln.state.clients.errors[0]).copy()
+    vel0 = np.asarray(ln.state.clients.velocities[0]).copy()
+    w_before = weight(ln)
+    # round 2: client 2 real, second slot padded (mask all-zero, id 0)
+    ids2 = np.array([2, 0])
+    xpad = np.stack([X, np.zeros_like(X)])
+    ypad = np.stack([Y, np.zeros_like(Y)])
+    mask2 = np.stack([np.ones(4, np.float32), np.zeros(4, np.float32)])
+    out = ln.train_round(ids2, (xpad, ypad), mask2)
+    # padded slot must not count as an uploader
+    assert out["upload_bytes"] == 4.0 * cfg.k * 1
+    assert out["num_datapoints"] == 4.0
+    # client 0's rows untouched by the padded slot
+    np.testing.assert_array_equal(np.asarray(ln.state.clients.errors[0]),
+                                  err0)
+    np.testing.assert_array_equal(np.asarray(ln.state.clients.velocities[0]),
+                                  vel0)
+    # and client 0's last-participation round was not advanced
+    assert int(ln.state.client_last_round[0]) == 0
+    assert int(ln.state.client_last_round[2]) == 1
+
+
+def test_download_counts_own_round_update():
+    # a client participating in consecutive rounds must re-download the
+    # weights changed by the round it just participated in (>= semantics)
+    cfg = FedConfig(mode="uncompressed", virtual_momentum=0.0,
+                    local_momentum=0, error_type="none", weight_decay=0,
+                    num_workers=1, num_clients=2, lr_scale=0.02)
+    ln = toy_learner(cfg)
+    d = ln.cfg.grad_size
+    ids, batch, mask = one_worker_batch()
+    ln.train_round(ids, batch, mask)          # round 0: nothing to download
+    out = ln.train_round(ids, batch, mask)    # round 1: round-0 update is new
+    assert out["download_bytes"] == 4.0 * d
+
+
+def test_eval_step():
+    cfg = FedConfig(mode="uncompressed", virtual_momentum=0.0,
+                    local_momentum=0, error_type="none", weight_decay=0,
+                    num_workers=1, lr_scale=0.02)
+    ln = toy_learner(cfg)
+    mask = np.ones(4, np.float32)
+    out = ln.evaluate([((X, Y), mask)])
+    assert out["loss"] == pytest.approx(3.5, abs=1e-5)
+    assert out["num_datapoints"] == 4.0
