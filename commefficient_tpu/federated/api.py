@@ -34,7 +34,7 @@ class FedLearner:
     def __init__(self, module, cfg: FedConfig, loss_train: Callable,
                  loss_val: Optional[Callable], rng: jax.Array,
                  sample_input, lr_schedule: Optional[Callable] = None,
-                 mesh=None, init_params=None):
+                 mesh=None, init_params=None, trainable_mask=None):
         self.module = module
         init_rng, self.rng = jax.random.split(rng)
         if init_params is None:
@@ -52,7 +52,8 @@ class FedLearner:
             self.state = shard_state(self.state, self.cfg, mesh)
             self._batch_sh = batch_shardings(mesh)
         self._round = build_round_step(loss_train, unflatten, self.cfg,
-                                       mesh=mesh)
+                                       mesh=mesh,
+                                       trainable_mask=trainable_mask)
         self._eval = build_eval_step(loss_val or loss_train, unflatten)
         self.lr_schedule = lr_schedule or (lambda t: cfg.lr_scale)
         self.rounds_done = 0

@@ -70,14 +70,22 @@ def reconstruct_worker_weights(ps_weights, stale_weights, cfg: FedConfig):
 
 
 def compute_gradient(apply_loss, unflatten, forward_weights, batch, mask,
-                     rng, cfg: FedConfig, sketch: Optional[CountSketch]):
+                     rng, cfg: FedConfig, sketch: Optional[CountSketch],
+                     trainable_mask=None):
     """The forward_grad equivalent (ref fed_worker.py:249-335): returns the
-    (possibly sketched) *mean* gradient and summed loss/metrics."""
+    (possibly sketched) *mean* gradient and summed loss/metrics.
+
+    ``trainable_mask`` zeros frozen coordinates BEFORE momentum/error/
+    compression — the analog of the reference's requires_grad=False
+    (frozen params never enter the gradient vector there), so top-k budgets
+    and sketch capacity are spent only on trainable weights."""
     n = jnp.sum(mask)
     safe_n = jnp.maximum(n, 1.0)
     grad_sum, loss_sum, metric_sums = _masked_loss_and_grad(
         apply_loss, unflatten, forward_weights, batch, mask, rng)
     grad = grad_sum / safe_n
+    if trainable_mask is not None:
+        grad = grad * trainable_mask
 
     # gradient clipping on the raw gradient, before weight decay — matches
     # clip_grad_norm_ placement at ref fed_worker.py:290-292 (non-sketch)
@@ -85,9 +93,13 @@ def compute_gradient(apply_loss, unflatten, forward_weights, batch, mask,
         grad = _clip_to_norm(grad, cfg.max_grad_norm)
 
     # weight decay folded into the gradient (ref utils.py:254-259); divided
-    # by num_workers because every worker adds it and the server sums
+    # by num_workers because every worker adds it and the server sums;
+    # frozen coordinates get no decay (they're not trainable params)
     if cfg.weight_decay != 0:
-        grad = grad + (cfg.weight_decay / cfg.num_workers) * forward_weights
+        wd = (cfg.weight_decay / cfg.num_workers) * forward_weights
+        if trainable_mask is not None:
+            wd = wd * trainable_mask
+        grad = grad + wd
 
     # worker-side differential privacy (ref fed_worker.py:304-309)
     if cfg.do_dp:
@@ -114,7 +126,8 @@ def compute_gradient(apply_loss, unflatten, forward_weights, batch, mask,
 
 def client_step(apply_loss, unflatten, ps_weights, batch, mask, velocity,
                 error, stale_weights, rng, cfg: FedConfig,
-                sketch: Optional[CountSketch]) -> ClientStepOut:
+                sketch: Optional[CountSketch],
+                trainable_mask=None) -> ClientStepOut:
     """One non-fedavg client's local step (ref local_step fed_worker.py:184-230)."""
     if cfg.do_topk_down:
         forward_weights = reconstruct_worker_weights(
@@ -125,7 +138,8 @@ def client_step(apply_loss, unflatten, ps_weights, batch, mask, velocity,
         new_stale = None
 
     g, loss_sum, metric_sums, n = compute_gradient(
-        apply_loss, unflatten, forward_weights, batch, mask, rng, cfg, sketch)
+        apply_loss, unflatten, forward_weights, batch, mask, rng, cfg, sketch,
+        trainable_mask=trainable_mask)
 
     # sum-of-gradients semantics: scale the mean grad back up by the true
     # batch size so the server can divide by total datapoints (ref :190)
@@ -157,7 +171,8 @@ def client_step(apply_loss, unflatten, ps_weights, batch, mask, velocity,
 
 
 def fedavg_client_step(apply_loss, unflatten, ps_weights, batch, mask, lr,
-                       rng, cfg: FedConfig) -> ClientStepOut:
+                       rng, cfg: FedConfig,
+                       trainable_mask=None) -> ClientStepOut:
     """FedAvg: multi-epoch local SGD on this client's whole (padded) dataset,
     transmitting the weight delta scaled by the client's datapoint count
     (ref fed_worker.py:61-113) — as a lax.scan over static-shaped chunks.
@@ -190,7 +205,8 @@ def fedavg_client_step(apply_loss, unflatten, ps_weights, batch, mask, lr,
         mmask = jax.lax.dynamic_slice_in_dim(mask_p, start, chunk)
         g, loss_sum, metric_sums, n = compute_gradient(
             apply_loss, unflatten, w, mb, mmask,
-            jax.random.fold_in(rng, step), cfg, None)
+            jax.random.fold_in(rng, step), cfg, None,
+            trainable_mask=trainable_mask)
         decay = cfg.fedavg_lr_decay ** step
         # g is already the mean grad over the chunk (ref :98-101 divides)
         w = w - g * lr * decay * jnp.where(n > 0, 1.0, 0.0)

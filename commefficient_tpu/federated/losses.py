@@ -30,6 +30,60 @@ def make_cv_loss(model):
     return apply_loss
 
 
+def _lm_nll_per_example(lm_logits, lm_labels):
+    """Mean shifted cross-entropy over labeled (!= -1) positions, per dialog.
+
+    The reference uses CrossEntropyLoss(ignore_index=-1) over the flattened
+    batch (reference gpt2_train.py:77-87); per-example averaging here makes
+    the loss a (B,) vector for the masked federated round, with each dialog
+    weighted equally (documented divergence: the reference's global mean
+    weights dialogs by their token counts).
+    """
+    logits = lm_logits[..., :-1, :]
+    labels = lm_labels[..., 1:]
+    valid = labels != -1
+    safe = jnp.where(valid, labels, 0)
+    nll = optax.softmax_cross_entropy_with_integer_labels(logits, safe)
+    nll = jnp.where(valid, nll, 0.0)
+    denom = jnp.maximum(jnp.sum(valid, axis=(-2, -1)), 1)
+    return jnp.sum(nll, axis=(-2, -1)) / denom
+
+
+def make_gpt2_train_loss(model, lm_coef: float = 1.0, mc_coef: float = 1.0):
+    """LM + multiple-choice loss (reference compute_loss_train,
+    gpt2_train.py:88-99)."""
+
+    def apply_loss(params, batch, rng, train):
+        input_ids, mc_token_ids, lm_labels, mc_labels, token_type_ids = batch
+        lm_logits, mc_logits = model.apply(
+            {"params": params}, input_ids, token_type_ids, mc_token_ids,
+            train=train, rngs={"dropout": rng} if train else None)
+        lm_loss = _lm_nll_per_example(lm_logits, lm_labels)
+        mc_loss = optax.softmax_cross_entropy_with_integer_labels(
+            mc_logits, mc_labels)
+        loss = lm_coef * lm_loss + mc_coef * mc_loss
+        return loss, jnp.zeros((1, loss.shape[0]))
+
+    return apply_loss
+
+
+def make_gpt2_val_loss(model):
+    """NLL + multiple-choice accuracy (reference compute_loss_val,
+    gpt2_train.py:77-87); perplexity = exp(mean nll) at rollup
+    (ref test_gpt2 :149-167)."""
+
+    def apply_loss(params, batch, rng, train):
+        input_ids, mc_token_ids, lm_labels, mc_labels, token_type_ids = batch
+        lm_logits, mc_logits = model.apply(
+            {"params": params}, input_ids, token_type_ids, mc_token_ids,
+            train=False)
+        nll = _lm_nll_per_example(lm_logits, lm_labels)
+        acc = (jnp.argmax(mc_logits, -1) == mc_labels).astype(jnp.float32)
+        return nll, acc[None, :]
+
+    return apply_loss
+
+
 def make_regression_loss(model):
     """Squared error, for the golden-value toy problems."""
 

@@ -1,0 +1,132 @@
+"""GPT-2 with double heads (LM + multiple-choice), flax/TPU-native.
+
+Reference uses ``pytorch_transformers`` GPT2DoubleHeadsModel
+(reference gpt2_train.py:262-273): LM head tied to the token embedding and a
+scalar multiple-choice head read at each candidate's last token
+(``mc_token_ids``). Input layout follows the PersonaChat convention
+(reference fed_persona.py:330-358): ``input_ids``/``token_type_ids`` are
+(batch, num_candidates, seq_len); ``token_type_ids`` index the same
+embedding table as tokens; padded positions are attended (the reference
+passes no attention mask) and excluded from the loss via ``lm_labels == -1``.
+
+TPU-first details: bf16-friendly matmuls (dtype parameter), static causal
+mask via jnp.tril, everything shape-static so pjit/ring-attention can shard
+the sequence axis later.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class GPT2Config:
+    def __init__(self, vocab_size=50262, n_positions=512, n_embd=768,
+                 n_layer=12, n_head=12, dropout=0.1, dtype="float32"):
+        self.vocab_size = vocab_size
+        self.n_positions = n_positions
+        self.n_embd = n_embd
+        self.n_layer = n_layer
+        self.n_head = n_head
+        self.dropout = dropout
+        self.dtype = dtype  # "float32" | "bfloat16" compute dtype
+
+    @property
+    def jnp_dtype(self):
+        return jnp.bfloat16 if self.dtype == "bfloat16" else jnp.float32
+
+    @classmethod
+    def small(cls, vocab_size=50262):
+        return cls(vocab_size=vocab_size)
+
+    @classmethod
+    def tiny(cls, vocab_size=300):
+        """For tests and offline byte-tokenizer runs."""
+        return cls(vocab_size=vocab_size, n_positions=256, n_embd=128,
+                   n_layer=2, n_head=4, dropout=0.0)
+
+
+class CausalSelfAttention(nn.Module):
+    n_head: int
+    dropout: float
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        B, T, C = x.shape
+        qkv = nn.Dense(3 * C, dtype=self.dtype,
+                       kernel_init=nn.initializers.normal(0.02))(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        heads = lambda t: t.reshape(B, T, self.n_head, C // self.n_head)
+        q, k, v = heads(q), heads(k), heads(v)
+        att = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(C // self.n_head)
+        causal = jnp.tril(jnp.ones((T, T), bool))
+        att = jnp.where(causal[None, None], att, jnp.finfo(att.dtype).min)
+        att = jax.nn.softmax(att, axis=-1)
+        att = nn.Dropout(self.dropout, deterministic=not train)(att)
+        y = jnp.einsum("bhqk,bkhd->bqhd", att, v).reshape(B, T, C)
+        y = nn.Dense(C, dtype=self.dtype,
+                     kernel_init=nn.initializers.normal(0.02))(y)
+        return nn.Dropout(self.dropout, deterministic=not train)(y)
+
+
+class Block(nn.Module):
+    n_head: int
+    dropout: float
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        x = x + CausalSelfAttention(self.n_head, self.dropout,
+                                    self.dtype)(h, train)
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        m = nn.Dense(4 * x.shape[-1], dtype=self.dtype,
+                     kernel_init=nn.initializers.normal(0.02))(h)
+        m = nn.gelu(m)
+        m = nn.Dense(x.shape[-1], dtype=self.dtype,
+                     kernel_init=nn.initializers.normal(0.02))(m)
+        return x + nn.Dropout(self.dropout, deterministic=not train)(m)
+
+
+class GPT2DoubleHeads(nn.Module):
+    """Returns (lm_logits (B,C,T,V), mc_logits (B,C))."""
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids, mc_token_ids,
+                 train: bool = True):
+        cfg = self.config
+        B, C, T = input_ids.shape
+        ids = input_ids.reshape(B * C, T)
+        types = token_type_ids.reshape(B * C, T)
+
+        wte = nn.Embed(cfg.vocab_size, cfg.n_embd,
+                       embedding_init=nn.initializers.normal(0.02),
+                       name="wte")
+        wpe = nn.Embed(cfg.n_positions, cfg.n_embd,
+                       embedding_init=nn.initializers.normal(0.01),
+                       name="wpe")
+        pos = jnp.arange(T)[None, :]
+        x = wte(ids) + wpe(pos) + wte(types)
+        x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
+        for _ in range(cfg.n_layer):
+            x = Block(cfg.n_head, cfg.dropout, cfg.jnp_dtype)(x, train)
+        x = nn.LayerNorm()(x.astype(jnp.float32))
+
+        # LM head tied to wte (GPT-2 weight tying); logits in f32
+        lm_logits = wte.attend(x)
+        lm_logits = lm_logits.reshape(B, C, T, cfg.vocab_size)
+
+        # multiple-choice head: hidden state at each candidate's last token
+        mc_ids = mc_token_ids.reshape(B * C)
+        picked = x[jnp.arange(B * C), mc_ids]          # (B*C, n_embd)
+        picked = nn.Dropout(cfg.dropout, deterministic=not train)(picked)
+        mc = nn.Dense(1, kernel_init=nn.initializers.normal(0.02),
+                      name="mc_head")(picked)
+        mc_logits = mc.reshape(B, C)
+        return lm_logits, mc_logits

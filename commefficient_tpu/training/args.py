@@ -62,6 +62,13 @@ def build_parser(default_lr: float = 0.4) -> argparse.ArgumentParser:
     p.add_argument("--iid", action="store_true", dest="do_iid")
     p.add_argument("--mesh", type=str, default="",
                    help="mesh shape as 'clients=N' (default: all devices)")
+    # GPT2 / PersonaChat (ref utils.py:185-208)
+    p.add_argument("--model_checkpoint", type=str, default="gpt2")
+    p.add_argument("--num_candidates", type=int, default=2)
+    p.add_argument("--max_history", type=int, default=2)
+    p.add_argument("--lm_coef", type=float, default=1.0)
+    p.add_argument("--mc_coef", type=float, default=1.0)
+    p.add_argument("--personality_permutations", type=int, default=1)
     # DP
     p.add_argument("--dp", action="store_true", dest="do_dp")
     p.add_argument("--dp_mode", choices=DP_MODES, default="worker")

@@ -38,7 +38,17 @@ def load_checkpoint(fn: str, learner) -> None:
     """Restore in place; the learner must be built with the same config."""
     with np.load(fn) as z:
         flat, treedef = _state_arrays(learner.state)
+        n_saved = sum(1 for k in z.files if k.startswith("arr_"))
+        if n_saved != len(flat):
+            raise ValueError(
+                f"checkpoint {fn} has {n_saved} state arrays, learner "
+                f"expects {len(flat)} — config/mode mismatch")
         restored = [z[f"arr_{i}"] for i in range(len(flat))]
+        for i, (cur, new) in enumerate(zip(flat, restored)):
+            if tuple(cur.shape) != tuple(new.shape):
+                raise ValueError(
+                    f"checkpoint {fn} array {i} has shape {new.shape}, "
+                    f"learner expects {cur.shape} — model/config mismatch")
         learner.state = jax.tree_util.tree_unflatten(
             treedef, [jax.numpy.asarray(x) for x in restored])
         learner.rounds_done = int(z["rounds_done"])

@@ -1,0 +1,52 @@
+"""Finetune helpers (reference cv_train.py:377-384 + resnet9.py:105-113:
+load a pretrained state dict, swap the classifier head, freeze the rest)."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def mask_for_params(params, predicate: Callable[[str], bool]) -> jax.Array:
+    """Flat 0/1 mask over ravel_pytree order; trainable where
+    ``predicate('/'.join(path))`` is True."""
+    flat_with_path, _ = jax.tree_util.tree_flatten_with_path(params)
+    parts = []
+    for path, leaf in flat_with_path:
+        name = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                        for p in path)
+        parts.append(np.full(int(np.prod(leaf.shape)),
+                             1.0 if predicate(name) else 0.0, np.float32))
+    return jnp.concatenate([jnp.asarray(p) for p in parts])
+
+
+def _module_sort_key(name: str):
+    """Order module paths by (depth, numeric suffix, name) so 'Dense_10'
+    ranks after 'Dense_9' and shallow (top-level) modules outrank nested
+    ones — plain lexicographic sorting gets both wrong."""
+    parts = name.split("/")
+    last = parts[-1]
+    suffix = last.rsplit("_", 1)[-1]
+    num = int(suffix) if suffix.isdigit() else -1
+    return (-len(parts), num, name)
+
+
+def head_only_mask(params, head_substring: str = "Dense") -> jax.Array:
+    """Trainable mask covering only the classifier head's parameters
+    (matches the reference's finetune_parameters: the last linear + scale).
+
+    The head is the shallowest, highest-numbered module whose path contains
+    ``head_substring``; pass an explicit substring (e.g. 'mc_head') when the
+    model's head is not the last top-level Dense."""
+    flat_with_path, _ = jax.tree_util.tree_flatten_with_path(params)
+    names = ["/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                      for p in path) for path, _ in flat_with_path]
+    head_names = [n.rsplit("/", 1)[0] for n in names if head_substring in n]
+    if not head_names:
+        raise ValueError(f"no parameter path contains {head_substring!r}; "
+                         f"paths: {names[:5]}...")
+    head = max(set(head_names), key=_module_sort_key)
+    return mask_for_params(params, lambda n: n.startswith(head))

@@ -1,0 +1,142 @@
+"""Aux-parity tests: checkpoint/resume, worker DP, finetune freezing,
+loggers, schedules."""
+
+import io
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from commefficient_tpu.config import FedConfig
+from commefficient_tpu.federated.api import FedLearner
+from commefficient_tpu.federated.losses import make_cv_loss, make_regression_loss
+from commefficient_tpu.models import TinyMLP, ToyLinear
+from commefficient_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+from commefficient_tpu.utils.finetune import head_only_mask
+from commefficient_tpu.utils.logging import TSVLogger, TableLogger, Timer
+from commefficient_tpu.utils.schedules import PiecewiseLinear, cifar_lr_schedule
+
+X = np.asarray([[0.0], [1.0], [2.0], [3.0]], np.float32)
+
+
+def make_learner(**cfg_kw):
+    cfg = FedConfig(mode="uncompressed", virtual_momentum=0.9,
+                    local_momentum=0, error_type="none", weight_decay=0,
+                    num_workers=1, num_clients=2, lr_scale=0.02, **cfg_kw)
+    model = ToyLinear()
+    return FedLearner(model, cfg, make_regression_loss(model), None,
+                      jax.random.PRNGKey(0), X[:1])
+
+
+def batch():
+    return np.array([0]), (X[None], X[None]), np.ones((1, 4), np.float32)
+
+
+def test_checkpoint_midtraining_resume(tmp_path):
+    # The reference can only save final weights (SURVEY.md §5: 'No
+    # mid-training resume'); we checkpoint the whole FedState.
+    ids, b, m = batch()
+    a = make_learner()
+    a.train_round(ids, b, m)
+    fn = save_checkpoint(str(tmp_path), a, "toy")
+    a.train_round(ids, b, m)
+    w_expected = float(a.state.weights[0])
+
+    fresh = make_learner()
+    load_checkpoint(fn, fresh)
+    assert fresh.rounds_done == 1
+    fresh.train_round(ids, b, m)
+    # momentum state survived the round trip: same trajectory
+    assert float(fresh.state.weights[0]) == pytest.approx(w_expected,
+                                                          abs=1e-7)
+
+
+def test_worker_dp_noise_and_clip():
+    ids, b, m = batch()
+    noisy = make_learner(do_dp=True, dp_mode="worker", noise_multiplier=0.5,
+                         l2_norm_clip=0.1)
+    clean = make_learner()
+    noisy.train_round(ids, b, m)
+    clean.train_round(ids, b, m)
+    w_noisy = float(noisy.state.weights[0])
+    w_clean = float(clean.state.weights[0])
+    assert w_noisy != pytest.approx(w_clean, abs=1e-9)
+    # clip bounds the update magnitude: |mean grad| clipped to 0.1 (+noise)
+    assert abs(w_noisy) < abs(w_clean)
+
+
+def test_finetune_head_only_mask_freezes_body():
+    model = TinyMLP(num_classes=2, hidden=4)
+    xs = np.random.RandomState(0).randn(8, 6).astype(np.float32)
+    ys = (xs[:, 0] > 0).astype(np.int32)
+    cfg = FedConfig(mode="uncompressed", virtual_momentum=0, local_momentum=0,
+                    error_type="none", weight_decay=0, num_workers=1,
+                    num_clients=2, lr_scale=0.1)
+    params = model.init(jax.random.PRNGKey(1), xs[:1],
+                        train=False)["params"]
+    mask = head_only_mask(params)
+    ln = FedLearner(model, cfg, make_cv_loss(model), None,
+                    jax.random.PRNGKey(0), xs[:1], init_params=params,
+                    trainable_mask=mask)
+    w0 = np.asarray(ln.state.weights).copy()
+    ln.train_round(np.array([0]), (xs[None], ys[None]),
+                   np.ones((1, 8), np.float32))
+    w1 = np.asarray(ln.state.weights)
+    changed = w1 != w0
+    frozen = np.asarray(mask) == 0
+    assert not np.any(changed & frozen)      # body untouched
+    assert np.any(changed & ~frozen)         # head moved
+
+
+def test_finetune_mask_applies_before_compression():
+    # with local_topk, frozen-body gradients must not consume the k budget
+    # (the mask is applied client-side, before top-k — like the reference's
+    # requires_grad=False)
+    model = TinyMLP(num_classes=2, hidden=4)
+    xs = np.random.RandomState(0).randn(8, 6).astype(np.float32)
+    ys = (xs[:, 0] > 0).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(1), xs[:1],
+                        train=False)["params"]
+    mask = head_only_mask(params)
+    k = int(np.sum(np.asarray(mask) > 0))  # k == head size
+    cfg = FedConfig(mode="local_topk", error_type="none", k=k,
+                    virtual_momentum=0, local_momentum=0, weight_decay=0,
+                    num_workers=1, num_clients=2, lr_scale=0.1)
+    ln = FedLearner(model, cfg, make_cv_loss(model), None,
+                    jax.random.PRNGKey(0), xs[:1], init_params=params,
+                    trainable_mask=mask)
+    w0 = np.asarray(ln.state.weights).copy()
+    for _ in range(3):
+        ln.train_round(np.array([0]), (xs[None], ys[None]),
+                       np.ones((1, 8), np.float32))
+    w1 = np.asarray(ln.state.weights)
+    head = np.asarray(mask) > 0
+    # the entire k budget reached the head: it moved substantially
+    assert np.sum((w0 != w1) & head) > 0
+    assert not np.any((w0 != w1) & ~head)
+
+
+def test_schedules():
+    s = cifar_lr_schedule(0.4, 5, 24)
+    assert s(0) == 0
+    assert s(5) == pytest.approx(0.4)
+    assert s(24) == pytest.approx(0.0)
+    assert s(30) == pytest.approx(0.0)       # clamped
+    p = PiecewiseLinear([0, 2], [1.0, 3.0])
+    assert p(1) == pytest.approx(2.0)
+
+
+def test_loggers(capsys):
+    t = TableLogger()
+    t.append({"epoch": 1, "loss": 0.5})
+    t.append({"epoch": 2, "loss": 0.25})
+    out = capsys.readouterr().out
+    assert "epoch" in out and "0.2500" in out
+    tsv = TSVLogger()
+    tsv.append({"epoch": 1, "total_time": 3600, "test_acc": 0.9})
+    assert "1\t1.00000000\t90.00" in str(tsv)
+    timer = Timer()
+    dt = timer()
+    assert dt >= 0 and timer.total_time >= dt
