@@ -110,7 +110,11 @@ def compute_gradient(apply_loss, unflatten, forward_weights, batch, mask,
                            jnp.sqrt(float(cfg.num_workers)) *
                            jax.random.normal(noise_rng, grad.shape))
 
-    if cfg.mode == "sketch":
+    # sketch is None in sketch mode when the round uses the
+    # sketch-after-aggregate fast path (see round.build_round_step):
+    # with no per-worker nonlinearity the sum of sketches equals the
+    # sketch of the sum, so the round sketches once after aggregation
+    if cfg.mode == "sketch" and sketch is not None:
         g = sketch.sketch_vec(grad)
         if cfg.max_grad_norm is not None:
             # sketch-space clip via l2 estimate (ref fed_worker.py:317-319)
