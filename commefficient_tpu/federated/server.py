@@ -92,15 +92,14 @@ def _local_topk(summed_local_topk, state, cfg, lr):
     return v * lr, ServerOptState(Vvelocity=v, Verror=state.Verror)
 
 
-def _sketched(sketched_grad, state, cfg, lr, sketch: CountSketch,
-              sketch_layout=None):
+def _sketched(sketched_grad, state, cfg, lr, sketch: CountSketch):
     v = _momentum(sketched_grad, state.Vvelocity, cfg.virtual_momentum)
     # 'virtual' accumulates; 'none' recovers straight from the momentum table
     # (sketch+'local' is rejected by FedConfig.validate)
     err = state.Verror + v if cfg.error_type == "virtual" else v
     update = sketch.unsketch(err, cfg.k)
     # the update's footprint *in sketch space* (re-sketch of the dense update)
-    sketched_update = sketch.sketch_vec(update, sketch_layout)
+    sketched_update = sketch.sketch_vec(update)
     support = sketched_update != 0
     if cfg.error_type == "virtual":
         err = jnp.where(support, 0.0, err)
@@ -116,7 +115,6 @@ def server_update(
     lr,
     sketch: Optional[CountSketch] = None,
     noise_rng: Optional[jax.Array] = None,
-    sketch_layout=None,
 ) -> Tuple[jax.Array, ServerOptState]:
     """Dispatch to the mode's update rule (ref get_server_update :469-481).
 
@@ -133,5 +131,5 @@ def server_update(
     if cfg.mode == "sketch":
         if sketch is None:
             sketch = make_sketch(cfg)
-        return _sketched(gradient, state, cfg, lr, sketch, sketch_layout)
+        return _sketched(gradient, state, cfg, lr, sketch)
     raise ValueError(f"unknown mode {cfg.mode!r}")

@@ -62,17 +62,6 @@ def _mix(x: jax.Array) -> jax.Array:
     return x
 
 
-def int_mix(x: np.ndarray) -> np.ndarray:
-    """numpy twin of _mix — MUST stay bit-identical (the host-precomputed
-    sorted layout and the in-trace hashes describe the same sketch)."""
-    x = x ^ (x >> np.uint32(16))
-    x = x * np.uint32(0x85EBCA6B)
-    x = x ^ (x >> np.uint32(13))
-    x = x * np.uint32(0xC2B2AE35)
-    x = x ^ (x >> np.uint32(16))
-    return x
-
-
 class CountSketch:
     """Stateless CountSketch over vectors of length ``d`` into ``(r, c)``."""
 
@@ -113,17 +102,12 @@ class CountSketch:
 
     # NOTE on the scatter: segment_sum with data-dependent indices is the
     # one XLA-hostile op here (SURVEY.md §7 hard parts). A precomputed
-    # sort-by-bucket layout (gather + sorted segmented reduce) was measured
-    # at 647ms vs 321ms for this on-the-fly scatter on a v5e at
-    # d=6.5M/c=500k — the random gather costs more than the scatter saves —
+    # sort-by-bucket layout (gather + sorted segmented reduce) was tried and
+    # measured slower — the random gather costs more than the scatter saves —
     # so the simple formulation below is also the fast one.
     @partial(jax.jit, static_argnums=0)
-    def sketch_vec(self, vec: jax.Array, layout=None) -> jax.Array:
-        """Sketch a length-d vector into an (r, c) table.
-
-        ``layout`` is accepted for call-site compatibility and ignored (a
-        precomputed sorted layout measured slower than in-trace hashing)."""
-        del layout
+    def sketch_vec(self, vec: jax.Array) -> jax.Array:
+        """Sketch a length-d vector into an (r, c) table."""
         idx = jnp.arange(self.d, dtype=jnp.int32)
 
         def one_row(row):
@@ -133,25 +117,8 @@ class CountSketch:
 
         return jnp.stack([one_row(row) for row in range(self.r)])
 
-    def accumulate_vec(self, table: jax.Array, vec: jax.Array,
-                       layout=None) -> jax.Array:
-        return table + self.sketch_vec(vec, layout)
-
-    @partial(jax.jit, static_argnums=0)
-    def support_table(self, indices: jax.Array) -> jax.Array:
-        """Boolean (r, c) mask of buckets hit by the given coordinate
-        ``indices`` — the support of sketch_vec(x) for any x whose nonzeros
-        are exactly ``indices``, at O(r*k) instead of O(r*d) cost.
-
-        (Degenerate difference from re-sketching: a bucket whose incoming
-        values cancel to exactly 0.0 in float would be 'nonzero' here but
-        zero there — a measure-zero event the error-feedback masking can
-        tolerate.)"""
-        def one_row(row):
-            _, buckets = self._row_hashes(row, indices)
-            return jnp.zeros((self.c,), bool).at[buckets].set(True)
-
-        return jnp.stack([one_row(row) for row in range(self.r)])
+    def accumulate_vec(self, table: jax.Array, vec: jax.Array) -> jax.Array:
+        return table + self.sketch_vec(vec)
 
     @partial(jax.jit, static_argnums=0)
     def estimates(self, table: jax.Array) -> jax.Array:

@@ -63,11 +63,6 @@ def fed_state_shardings(cfg: FedConfig, mesh: Mesh, axis: str = "clients"):
         errors=row if cfg.needs_error_state else None,
         weights=row if cfg.needs_client_weights else None,
     )
-    layout_sh = None
-    if cfg.mode == "sketch":
-        from commefficient_tpu.federated.server import make_sketch
-        if make_sketch(cfg).get_layout() is not None:
-            layout_sh = (rep, rep, rep)
     return FedState(
         weights=rep,
         opt=ServerOptState(Vvelocity=rep, Verror=rep),
@@ -75,7 +70,6 @@ def fed_state_shardings(cfg: FedConfig, mesh: Mesh, axis: str = "clients"):
         round_idx=rep,
         last_changed=rep,
         client_last_round=row,
-        sketch_layout=layout_sh,
     )
 
 

@@ -26,10 +26,7 @@ def _state_arrays(state):
 def save_checkpoint(path: str, learner, name: str = "model") -> str:
     os.makedirs(path, exist_ok=True)
     fn = os.path.join(path, f"{name}.npz")
-    # the sketch layout is static data derived from the seed — hundreds of
-    # MB that need not be checkpointed; it is rebuilt at learner init
-    state = learner.state.replace(sketch_layout=None)
-    flat, _ = _state_arrays(state)
+    flat, _ = _state_arrays(learner.state)
     np.savez(fn, rounds_done=learner.rounds_done,
              total_download_bytes=learner.total_download_bytes,
              total_upload_bytes=learner.total_upload_bytes,
@@ -39,10 +36,8 @@ def save_checkpoint(path: str, learner, name: str = "model") -> str:
 
 def load_checkpoint(fn: str, learner) -> None:
     """Restore in place; the learner must be built with the same config."""
-    layout = learner.state.sketch_layout
     with np.load(fn) as z:
-        flat, treedef = _state_arrays(learner.state.replace(
-            sketch_layout=None))
+        flat, treedef = _state_arrays(learner.state)
         n_saved = sum(1 for k in z.files if k.startswith("arr_"))
         if n_saved != len(flat):
             raise ValueError(
@@ -55,8 +50,7 @@ def load_checkpoint(fn: str, learner) -> None:
                     f"checkpoint {fn} array {i} has shape {new.shape}, "
                     f"learner expects {cur.shape} — model/config mismatch")
         learner.state = jax.tree_util.tree_unflatten(
-            treedef, [jax.numpy.asarray(x) for x in restored]).replace(
-                sketch_layout=layout)
+            treedef, [jax.numpy.asarray(x) for x in restored])
         learner.rounds_done = int(z["rounds_done"])
         learner.total_download_bytes = float(z["total_download_bytes"])
         learner.total_upload_bytes = float(z["total_upload_bytes"])

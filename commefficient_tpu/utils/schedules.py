@@ -1,7 +1,8 @@
 """Learning-rate schedules (reference utils.py:26-35).
 
-Callables of a (possibly fractional) epoch/step count, usable both host-side
-and in-trace (pure jnp.interp / power).
+Host-side callables of a (possibly fractional) epoch/step count. The lr
+enters the jitted round step as a scalar argument, so these run outside the
+trace (np.interp + float()); they are NOT tracer-safe.
 """
 
 from __future__ import annotations
