@@ -40,18 +40,64 @@ class ClientStepOut(NamedTuple):
     num_datapoints: jax.Array
 
 
-def _masked_loss_and_grad(apply_loss, unflatten, w_flat, batch, mask, rng):
-    """Gradient of the *summed* loss over valid examples + summed metrics."""
+def _masked_loss_and_grad(apply_loss, unflatten, w_flat, batch, mask, rng,
+                          microbatch_size: int = -1):
+    """Gradient of the *summed* loss over valid examples + summed metrics.
 
-    def loss_sum_fn(flat):
-        params = unflatten(flat)
-        per_ex_loss, per_ex_metrics = apply_loss(params, batch, rng, True)
-        loss_sum = jnp.sum(per_ex_loss * mask)
-        metric_sums = jnp.sum(per_ex_metrics * mask[None, :], axis=-1)
-        return loss_sum, (loss_sum, metric_sums)
+    ``microbatch_size > 0`` splits the batch into chunks and accumulates the
+    gradient over a ``lax.scan`` — the reference's microbatch loop
+    (fed_worker.py:265-287), which bounds peak activation memory to one
+    microbatch (the enabler for GPT2 whole-client batches on one chip).
+    Because the gradient is of a *sum*, chunked accumulation is numerically
+    the same computation as the one-shot path (same adds, scan order).
+    """
 
-    grads, (loss_sum, metric_sums) = jax.grad(
-        loss_sum_fn, has_aux=True)(w_flat)
+    def chunk_grad(flat, chunk_batch, chunk_mask, chunk_rng):
+        def loss_sum_fn(f):
+            params = unflatten(f)
+            per_ex_loss, per_ex_metrics = apply_loss(
+                params, chunk_batch, chunk_rng, True)
+            loss_sum = jnp.sum(per_ex_loss * chunk_mask)
+            metric_sums = jnp.sum(per_ex_metrics * chunk_mask[None, :],
+                                  axis=-1)
+            return loss_sum, (loss_sum, metric_sums)
+
+        return jax.grad(loss_sum_fn, has_aux=True)(flat)
+
+    B = mask.shape[0]
+    if microbatch_size <= 0 or microbatch_size >= B:
+        grads, (loss_sum, metric_sums) = chunk_grad(w_flat, batch, mask, rng)
+        return grads, loss_sum, metric_sums
+
+    mb = microbatch_size
+    n_chunks = -(-B // mb)  # ceil
+    pad_to = n_chunks * mb
+
+    def pad_and_split(x):
+        pad_width = [(0, pad_to - B)] + [(0, 0)] * (x.ndim - 1)
+        return jnp.pad(x, pad_width).reshape((n_chunks, mb) + x.shape[1:])
+
+    batch_r = tuple(pad_and_split(t) for t in batch)
+    mask_r = pad_and_split(mask)
+    # per-chunk rng: only observable through stochastic pieces of the loss
+    # (dropout); deterministic losses match the one-shot path exactly
+    chunk_rngs = jax.vmap(lambda i: jax.random.fold_in(rng, i))(
+        jnp.arange(n_chunks))
+
+    _, (l_shape, m_shape) = jax.eval_shape(
+        chunk_grad, w_flat, tuple(t[0] for t in batch_r), mask_r[0],
+        chunk_rngs[0])
+
+    def body(carry, xs):
+        g_acc, l_acc, m_acc = carry
+        cb, cm, crng = xs
+        grads, (ls, ms) = chunk_grad(w_flat, cb, cm, crng)
+        return (g_acc + grads, l_acc + ls, m_acc + ms), None
+
+    init = (jnp.zeros_like(w_flat), jnp.zeros(l_shape.shape, l_shape.dtype),
+            jnp.zeros(m_shape.shape, m_shape.dtype))
+    (grads, loss_sum, metric_sums), _ = jax.lax.scan(
+        body, init, (batch_r, mask_r, chunk_rngs))
     return grads, loss_sum, metric_sums
 
 
@@ -82,7 +128,8 @@ def compute_gradient(apply_loss, unflatten, forward_weights, batch, mask,
     n = jnp.sum(mask)
     safe_n = jnp.maximum(n, 1.0)
     grad_sum, loss_sum, metric_sums = _masked_loss_and_grad(
-        apply_loss, unflatten, forward_weights, batch, mask, rng)
+        apply_loss, unflatten, forward_weights, batch, mask, rng,
+        microbatch_size=cfg.microbatch_size)
     grad = grad_sum / safe_n
     if trainable_mask is not None:
         grad = grad * trainable_mask

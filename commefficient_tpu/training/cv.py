@@ -58,8 +58,17 @@ def build_learner(args, sample_input, num_classes, channels, mesh=None):
     loss = make_cv_loss(model)
     sched = cifar_lr_schedule(args.lr_scale, args.pivot_epoch,
                               args.num_epochs)
+    init_params, trainable_mask = None, None
+    if args.do_finetune:
+        # pretrained backbone + fresh trainable head (ref cv_train.py:377-384)
+        from commefficient_tpu.utils.finetune import \
+            load_pretrained_for_finetune
+        init_params, trainable_mask = load_pretrained_for_finetune(
+            model, jax.random.PRNGKey(args.seed), sample_input,
+            args.finetune_path)
     return FedLearner(model, cfg, loss, loss, jax.random.PRNGKey(args.seed),
-                      sample_input, lr_schedule=sched, mesh=mesh)
+                      sample_input, lr_schedule=sched, mesh=mesh,
+                      init_params=init_params, trainable_mask=trainable_mask)
 
 
 def train(args, mesh=None, max_rounds=None, log=True):
@@ -78,45 +87,59 @@ def train(args, mesh=None, max_rounds=None, log=True):
                             mesh=mesh)
 
     table = TableLogger() if log else None
+    writer = None
+    if getattr(args, "use_tensorboard", False):
+        from commefficient_tpu.utils.logging import ScalarWriter, make_logdir
+        writer = ScalarWriter(make_logdir(args))
     timer = Timer()
     spe = batcher.steps_per_epoch()
     total_rounds = 0
-    for epoch in range(int(math.ceil(args.num_epochs))):
-        epoch_metrics = []
-        for ids, cols, mask in batcher.epoch():
-            frac = total_rounds / max(spe, 1)
-            out = learner.train_round(ids, cols, mask, epoch_frac=frac)
-            total_rounds += 1
-            epoch_metrics.append(out)
-            if not math.isfinite(out["loss"]) or \
-                    out["loss"] > args.nan_threshold:
-                print(f"NaN/divergent loss ({out['loss']}); aborting "
-                      f"(threshold {args.nan_threshold})")
-                return learner, {"aborted": True, "loss": out["loss"]}
+    try:
+        for epoch in range(int(math.ceil(args.num_epochs))):
+            epoch_metrics = []
+            for ids, cols, mask in batcher.epoch():
+                frac = total_rounds / max(spe, 1)
+                out = learner.train_round(ids, cols, mask, epoch_frac=frac)
+                total_rounds += 1
+                epoch_metrics.append(out)
+                if not math.isfinite(out["loss"]) or \
+                        out["loss"] > args.nan_threshold:
+                    print(f"NaN/divergent loss ({out['loss']}); aborting "
+                          f"(threshold {args.nan_threshold})")
+                    return learner, {"aborted": True, "loss": out["loss"]}
+                if args.do_test or (max_rounds and total_rounds >= max_rounds):
+                    break
+            train_time = timer()
+            val = learner.evaluate(val_batches(val_set,
+                                               args.valid_batch_size))
+            val_time = timer()
+            mean = lambda k: float(np.mean([m[k] for m in epoch_metrics]))
+            row = {
+                "epoch": epoch + 1,
+                "lr": epoch_metrics[-1]["lr"],
+                "train_loss": mean("loss"),
+                "train_acc": float(np.mean(
+                    [m["metrics"][0] for m in epoch_metrics])),
+                "train_time": train_time,
+                "test_loss": val["loss"],
+                "test_acc": float(val["metrics"][0]),
+                "test_time": val_time,
+                "down (MiB)": learner.total_download_bytes / 2**20,
+                "up (MiB)": learner.total_upload_bytes / 2**20,
+                "total_time": timer.total_time,
+            }
+            if table:
+                table.append(row)
+            if writer:
+                # the scalars the reference exports (cv_train.py:150-158)
+                for tag in ("train_loss", "train_acc", "train_time",
+                            "test_loss", "test_acc", "test_time", "lr"):
+                    writer.add_scalar(tag, row[tag], epoch + 1)
             if args.do_test or (max_rounds and total_rounds >= max_rounds):
                 break
-        train_time = timer()
-        val = learner.evaluate(val_batches(val_set, args.valid_batch_size))
-        val_time = timer()
-        mean = lambda k: float(np.mean([m[k] for m in epoch_metrics]))
-        row = {
-            "epoch": epoch + 1,
-            "lr": epoch_metrics[-1]["lr"],
-            "train_loss": mean("loss"),
-            "train_acc": float(np.mean(
-                [m["metrics"][0] for m in epoch_metrics])),
-            "train_time": train_time,
-            "test_loss": val["loss"],
-            "test_acc": float(val["metrics"][0]),
-            "test_time": val_time,
-            "down (MiB)": learner.total_download_bytes / 2**20,
-            "up (MiB)": learner.total_upload_bytes / 2**20,
-            "total_time": timer.total_time,
-        }
-        if table:
-            table.append(row)
-        if args.do_test or (max_rounds and total_rounds >= max_rounds):
-            break
+    finally:
+        if writer:
+            writer.close()
 
     if args.do_checkpoint:
         from commefficient_tpu.utils.checkpoint import save_checkpoint

@@ -101,38 +101,51 @@ def train(args, max_rounds=None, log=True):
                          lr_schedule=sched)
 
     table = TableLogger() if log else None
+    writer = None
+    if getattr(args, "use_tensorboard", False):
+        from commefficient_tpu.utils.logging import ScalarWriter, make_logdir
+        writer = ScalarWriter(make_logdir(args))
     timer = Timer()
     total_rounds = 0
     row = {}
-    for epoch in range(int(math.ceil(args.num_epochs))):
-        losses = []
-        for ids, cols, mask in batcher.epoch():
-            out = learner.train_round(ids, cols, mask,
-                                      epoch_frac=total_rounds)
-            total_rounds += 1
-            losses.append(out["loss"])
-            if not math.isfinite(out["loss"]):
-                print("NaN loss; aborting")
-                return learner, {"aborted": True}
+    try:
+        for epoch in range(int(math.ceil(args.num_epochs))):
+            losses = []
+            for ids, cols, mask in batcher.epoch():
+                out = learner.train_round(ids, cols, mask,
+                                          epoch_frac=total_rounds)
+                total_rounds += 1
+                losses.append(out["loss"])
+                if not math.isfinite(out["loss"]):
+                    print("NaN loss; aborting")
+                    return learner, {"aborted": True}
+                if args.do_test or (max_rounds and total_rounds >= max_rounds):
+                    break
+            train_time = timer()
+            val = learner.evaluate(val_batches(val_set,
+                                               args.valid_batch_size))
+            row = {
+                "epoch": epoch + 1,
+                "lr": out["lr"],
+                "train_loss": float(np.mean(losses)),
+                "nll": val["loss"],
+                "ppl": float(np.exp(min(val["loss"], 20.0))),
+                "mc_acc": float(val["metrics"][0]),
+                "time": train_time,
+                "down (MiB)": learner.total_download_bytes / 2**20,
+                "up (MiB)": learner.total_upload_bytes / 2**20,
+            }
+            if table:
+                table.append(row)
+            if writer:
+                # nll/ppl/mc_acc scalars (ref gpt2_train.py:162-164, 233-235)
+                for tag in ("train_loss", "nll", "ppl", "mc_acc", "lr"):
+                    writer.add_scalar(tag, row[tag], epoch + 1)
             if args.do_test or (max_rounds and total_rounds >= max_rounds):
                 break
-        train_time = timer()
-        val = learner.evaluate(val_batches(val_set, args.valid_batch_size))
-        row = {
-            "epoch": epoch + 1,
-            "lr": out["lr"],
-            "train_loss": float(np.mean(losses)),
-            "nll": val["loss"],
-            "ppl": float(np.exp(min(val["loss"], 20.0))),
-            "mc_acc": float(val["metrics"][0]),
-            "time": train_time,
-            "down (MiB)": learner.total_download_bytes / 2**20,
-            "up (MiB)": learner.total_upload_bytes / 2**20,
-        }
-        if table:
-            table.append(row)
-        if args.do_test or (max_rounds and total_rounds >= max_rounds):
-            break
+    finally:
+        if writer:
+            writer.close()
 
     if args.do_checkpoint:
         save_pretrained(args.checkpoint_path, learner, gcfg, tokenizer)

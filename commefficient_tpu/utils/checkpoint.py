@@ -27,9 +27,14 @@ def save_checkpoint(path: str, learner, name: str = "model") -> str:
     os.makedirs(path, exist_ok=True)
     fn = os.path.join(path, f"{name}.npz")
     flat, _ = _state_arrays(learner.state)
+    # record which leaf is the global weight vector so finetune can load it
+    # without reconstructing this run's FedState treedef (and without
+    # storing the dominant array twice)
+    widx = next(i for i, x in enumerate(flat) if x is learner.state.weights)
     np.savez(fn, rounds_done=learner.rounds_done,
              total_download_bytes=learner.total_download_bytes,
              total_upload_bytes=learner.total_upload_bytes,
+             weights_idx=widx,
              **{f"arr_{i}": np.asarray(x) for i, x in enumerate(flat)})
     return fn
 

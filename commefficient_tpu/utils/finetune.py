@@ -3,6 +3,7 @@ load a pretrained state dict, swap the classifier head, freeze the rest)."""
 
 from __future__ import annotations
 
+import os
 from typing import Callable
 
 import jax
@@ -50,3 +51,45 @@ def head_only_mask(params, head_substring: str = "Dense") -> jax.Array:
                          f"paths: {names[:5]}...")
     head = max(set(head_names), key=_module_sort_key)
     return mask_for_params(params, lambda n: n.startswith(head))
+
+
+def load_pretrained_for_finetune(module, rng, sample_input,
+                                 checkpoint_file: str,
+                                 head_substring: str = "Dense"):
+    """Build (init_params, trainable_mask) for a finetune run.
+
+    Reference semantics (cv_train.py:377-384 + resnet9.py finetune_parameters
+    :105-113): load the pretrained state dict, freeze every parameter, swap
+    in a FRESH head that alone stays trainable. Here: fresh-init the module,
+    overwrite every non-head coordinate with the checkpointed weights, and
+    return the head-only trainable mask for the round step.
+    """
+    if os.path.isdir(checkpoint_file):
+        cands = sorted(f for f in os.listdir(checkpoint_file)
+                       if f.endswith(".npz"))
+        if not cands:
+            raise FileNotFoundError(
+                f"no .npz checkpoint in {checkpoint_file}")
+        if len(cands) > 1:
+            raise ValueError(
+                f"{checkpoint_file} holds several checkpoints {cands}; "
+                "pass the specific .npz file")
+        checkpoint_file = os.path.join(checkpoint_file, cands[0])
+    from commefficient_tpu.utils.params import flatten_params
+    variables = module.init(rng, sample_input, train=False)
+    params = variables["params"]
+    flat, unflatten = flatten_params(params)
+    head_mask = head_only_mask(params, head_substring)
+    with np.load(checkpoint_file) as z:
+        if "weights_idx" not in z.files:
+            raise ValueError(
+                f"{checkpoint_file} has no 'weights_idx' marker — re-save "
+                "with this version's save_checkpoint")
+        saved = z[f"arr_{int(z['weights_idx'])}"]
+    if saved.shape != tuple(flat.shape):
+        raise ValueError(
+            f"pretrained weights have {saved.shape[0]} coordinates, model "
+            f"has {flat.shape[0]} — finetune requires the same architecture "
+            "(the head is re-initialized, not re-shaped)")
+    merged = jnp.where(head_mask > 0, flat, jnp.asarray(saved, flat.dtype))
+    return unflatten(merged), head_mask

@@ -48,6 +48,40 @@ class TSVLogger:
         return "\n".join(self.log)
 
 
+class ScalarWriter:
+    """Structured scalar export for ``--tensorboard`` (reference
+    cv_train.py:150-158, gpt2_train.py:233-235).
+
+    Uses torch.utils.tensorboard's SummaryWriter when the tensorboard
+    package is importable; otherwise falls back to an append-only
+    ``scalars.tsv`` (step, tag, value) in the same log dir — the data is
+    identical, only the container differs."""
+
+    def __init__(self, logdir: str):
+        os.makedirs(logdir, exist_ok=True)
+        self.logdir = logdir
+        self._tb = None
+        self._file = None
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+            self._tb = SummaryWriter(log_dir=logdir)
+        except Exception:
+            self._file = open(os.path.join(logdir, "scalars.tsv"), "a")
+
+    def add_scalar(self, tag: str, value, step: int):
+        if self._tb is not None:
+            self._tb.add_scalar(tag, value, step)
+        else:
+            self._file.write(f"{step}\t{tag}\t{float(value)}\n")
+
+    def close(self):
+        if self._tb is not None:
+            self._tb.flush()
+            self._tb.close()
+        else:
+            self._file.close()
+
+
 class Timer:
     def __init__(self, synch=None):
         self.synch = synch or (lambda: None)

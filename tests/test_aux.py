@@ -118,6 +118,55 @@ def test_finetune_mask_applies_before_compression():
     assert not np.any((w0 != w1) & ~head)
 
 
+def test_load_pretrained_for_finetune(tmp_path):
+    from commefficient_tpu.utils.finetune import load_pretrained_for_finetune
+    from commefficient_tpu.utils.params import flatten_params
+
+    model = TinyMLP(num_classes=2, hidden=4)
+    xs = np.random.RandomState(0).randn(8, 6).astype(np.float32)
+    ys = (xs[:, 0] > 0).astype(np.int32)
+    cfg = FedConfig(mode="uncompressed", virtual_momentum=0, local_momentum=0,
+                    error_type="none", weight_decay=0, num_workers=1,
+                    num_clients=2, lr_scale=0.1)
+    pre = FedLearner(model, cfg, make_cv_loss(model), None,
+                     jax.random.PRNGKey(0), xs[:1])
+    for _ in range(2):
+        pre.train_round(np.array([0]), (xs[None], ys[None]),
+                        np.ones((1, 8), np.float32))
+    fn = save_checkpoint(str(tmp_path), pre, "TinyMLP")
+
+    init_params, mask = load_pretrained_for_finetune(
+        model, jax.random.PRNGKey(7), xs[:1], fn)
+    flat, _ = flatten_params(init_params)
+    trained = np.asarray(pre.state.weights)
+    m = np.asarray(mask)
+    # body coordinates come from the checkpoint, head is fresh (not equal to
+    # the trained head, which moved away from any fresh init)
+    np.testing.assert_array_equal(np.asarray(flat)[m == 0], trained[m == 0])
+    assert np.any(np.asarray(flat)[m == 1] != trained[m == 1])
+    # directory form resolves to the single .npz inside
+    init_params2, _ = load_pretrained_for_finetune(
+        model, jax.random.PRNGKey(7), xs[:1], str(tmp_path))
+    flat2, _ = flatten_params(init_params2)
+    np.testing.assert_array_equal(np.asarray(flat), np.asarray(flat2))
+
+
+def test_scalar_writer_tsv_roundtrip(tmp_path):
+    from commefficient_tpu.utils.logging import ScalarWriter
+    w = ScalarWriter(str(tmp_path / "run"))
+    w.add_scalar("test_acc", 0.5, 1)
+    w.add_scalar("test_acc", 0.75, 2)
+    w.close()
+    import os
+    files = []
+    for root, _, fns in os.walk(tmp_path):
+        files += [os.path.join(root, f) for f in fns]
+    assert files, "writer produced no output files"
+    if any(f.endswith("scalars.tsv") for f in files):
+        content = open([f for f in files if f.endswith("scalars.tsv")][0]).read()
+        assert "1\ttest_acc\t0.5" in content
+
+
 def test_schedules():
     s = cifar_lr_schedule(0.4, 5, 24)
     assert s(0) == 0

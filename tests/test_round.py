@@ -232,6 +232,100 @@ def test_download_counts_own_round_update():
     assert out["download_bytes"] == 4.0 * d
 
 
+def test_sketch_dp_golden_per_client_branch():
+    # do_dp forces the per-client sketch path (no sketch-after-aggregate
+    # linearity shortcut). ToyLinear d=1: mean grad at w=0 is -7, clipped to
+    # l2_norm_clip=0.1 -> -0.1; a 1-coordinate sketch recovers it exactly,
+    # so w1 = lr * 0.1 = 0.002 (ref fed_worker.py:304-320).
+    cfg = FedConfig(mode="sketch", error_type="virtual", k=1, num_rows=5,
+                    num_cols=64, virtual_momentum=0.0, local_momentum=0,
+                    weight_decay=0, num_workers=1, lr_scale=0.02,
+                    do_dp=True, dp_mode="worker", l2_norm_clip=0.1,
+                    noise_multiplier=0.0)
+    ln = toy_learner(cfg)
+    ids, batch, mask = one_worker_batch()
+    ln.train_round(ids, batch, mask)
+    assert weight(ln) == pytest.approx(0.002, abs=1e-7)
+
+
+def test_sketch_grad_norm_clip_golden():
+    # max_grad_norm in sketch mode clips via the sketch-space l2 ESTIMATE
+    # (ref fed_worker.py:317-319 via clip_grad/l2estimate). d=1: the
+    # estimate is exact (|g| from every row), so grad -7 scales to -1 and
+    # w1 = 0.02.
+    cfg = FedConfig(mode="sketch", error_type="virtual", k=1, num_rows=5,
+                    num_cols=64, virtual_momentum=0.0, local_momentum=0,
+                    weight_decay=0, num_workers=1, lr_scale=0.02,
+                    max_grad_norm=1.0)
+    ln = toy_learner(cfg)
+    ids, batch, mask = one_worker_batch()
+    ln.train_round(ids, batch, mask)
+    assert weight(ln) == pytest.approx(0.02, abs=1e-6)
+
+
+def test_sketch_dp_matches_dense_equivalent():
+    # With k=d and a roomy sketch, FetchSGD's sketched momentum/error
+    # pipeline on per-client clipped grads must track the dense true_topk
+    # pipeline on the same clipped grads (the dense-equivalent computation
+    # of ref fed_worker.py:304-320 + _server_helper_sketched).
+    rng = np.random.RandomState(5)
+    Xs = rng.randn(32, 8).astype(np.float32)
+    ys = (Xs[:, 0] > 0).astype(np.int32)
+    model = TinyMLP(num_classes=2, hidden=4)
+    from commefficient_tpu.utils.params import flatten_params
+    flat0, _ = flatten_params(
+        model.init(jax.random.PRNGKey(2), Xs[:1], train=False)["params"])
+    d = flat0.shape[0]
+    trajs = {}
+    for mode in ("sketch", "true_topk"):
+        cfg = FedConfig(mode=mode, error_type="virtual", virtual_momentum=0.9,
+                        local_momentum=0, weight_decay=0, num_workers=2,
+                        num_clients=2, lr_scale=0.05, k=d, num_rows=7,
+                        num_cols=8192, do_dp=True, dp_mode="worker",
+                        l2_norm_clip=0.5, noise_multiplier=0.0)
+        ln = FedLearner(model, cfg, make_cv_loss(model), None,
+                        jax.random.PRNGKey(2), Xs[:1])
+        ids = np.arange(2)
+        batch = (Xs.reshape(2, 16, 8), ys.reshape(2, 16))
+        mask = np.ones((2, 16), np.float32)
+        for _ in range(5):
+            ln.train_round(ids, batch, mask)
+        trajs[mode] = np.asarray(ln.state.weights)
+    np.testing.assert_allclose(trajs["sketch"], trajs["true_topk"],
+                               atol=1e-4, rtol=0)
+
+
+def test_microbatch_equals_one_shot():
+    # gradient accumulation over lax.scan chunks must reproduce the
+    # one-shot gradient (ref microbatch loop fed_worker.py:265-287);
+    # mb=3 with B=8 also exercises the ragged-tail padding path
+    rng = np.random.RandomState(3)
+    Xs = rng.randn(16, 8).astype(np.float32)
+    ys = (Xs[:, 0] > 0).astype(np.int32)
+    model = TinyMLP(num_classes=2, hidden=16)
+    batch = (Xs.reshape(2, 8, 8), ys.reshape(2, 8))
+    mask = np.ones((2, 8), np.float32)
+    mask[1, 6:] = 0.0  # masked tail rows interact with chunk padding
+    ids = np.arange(2)
+
+    results = {}
+    for mb in (-1, 4, 3):
+        cfg = FedConfig(mode="uncompressed", error_type="none",
+                        virtual_momentum=0.9, local_momentum=0,
+                        weight_decay=1e-3, num_workers=2, num_clients=2,
+                        lr_scale=0.1, microbatch_size=mb)
+        ln = FedLearner(model, cfg, make_cv_loss(model), None,
+                        jax.random.PRNGKey(1), Xs[:1])
+        for _ in range(3):
+            out = ln.train_round(ids, batch, mask)
+        results[mb] = (np.asarray(ln.state.weights), out["loss"])
+
+    for mb in (4, 3):
+        np.testing.assert_allclose(results[mb][0], results[-1][0],
+                                   rtol=0, atol=1e-5)
+        assert results[mb][1] == pytest.approx(results[-1][1], abs=1e-5)
+
+
 def test_eval_step():
     cfg = FedConfig(mode="uncompressed", virtual_momentum=0.0,
                     local_momentum=0, error_type="none", weight_decay=0,
