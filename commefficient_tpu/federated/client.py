@@ -79,9 +79,13 @@ def _masked_loss_and_grad(apply_loss, unflatten, w_flat, batch, mask, rng,
 
     batch_r = tuple(pad_and_split(t) for t in batch)
     mask_r = pad_and_split(mask)
-    # per-chunk rng: only observable through stochastic pieces of the loss
-    # (dropout); deterministic losses match the one-shot path exactly
-    chunk_rngs = jax.vmap(lambda i: jax.random.fold_in(rng, i))(
+    # per-chunk rng in its own fold_in domain: folding the raw rng by chunk
+    # index would make chunk 1's key bitwise-equal to the DP noise key
+    # (fold_in(rng, 1) in compute_gradient). Only observable through
+    # stochastic pieces of the loss (dropout); deterministic losses match
+    # the one-shot path exactly.
+    mb_rng = jax.random.fold_in(rng, 0x4d42)
+    chunk_rngs = jax.vmap(lambda i: jax.random.fold_in(mb_rng, i))(
         jnp.arange(n_chunks))
 
     _, (l_shape, m_shape) = jax.eval_shape(

@@ -10,8 +10,10 @@ they are load-bearing for federated correctness (no cross-client BN leakage).
 from commefficient_tpu.models.resnet9 import ResNet9
 from commefficient_tpu.models.fixup_resnet9 import FixupResNet9
 from commefficient_tpu.models.fixup_resnet18 import FixupResNet18, ResNet18
+from commefficient_tpu.models.fixup_resnet50 import FixupResNet50
 from commefficient_tpu.models.resnets import (
     ResNetTV, resnet18, resnet34, resnet50, resnet101, resnet152,
+    resnext50_32x4d, resnext101_32x8d, wide_resnet50_2, wide_resnet101_2,
     ResNet101LN, ResNet50LN)
 from commefficient_tpu.models.toy import ToyLinear, TinyMLP
 
@@ -19,11 +21,16 @@ MODEL_REGISTRY = {
     "ResNet9": ResNet9,
     "FixupResNet9": FixupResNet9,
     "FixupResNet18": FixupResNet18,
+    "FixupResNet50": FixupResNet50,
     "ResNet18": ResNet18,
     "ResNet34": resnet34,
     "ResNet50": resnet50,
     "ResNet101": resnet101,
     "ResNet152": resnet152,
+    "ResNeXt50": resnext50_32x4d,
+    "ResNeXt101": resnext101_32x8d,
+    "WideResNet50": wide_resnet50_2,
+    "WideResNet101": wide_resnet101_2,
     "ResNet101LN": ResNet101LN,
     "ResNet50LN": ResNet50LN,
     "ToyLinear": ToyLinear,
@@ -39,6 +46,8 @@ def get_model(name: str, **kwargs):
 
 
 __all__ = ["MODEL_REGISTRY", "get_model", "ResNet9", "FixupResNet9",
-           "FixupResNet18", "ResNet18", "ResNetTV", "resnet18", "resnet34",
-           "resnet50", "resnet101", "resnet152", "ResNet101LN", "ResNet50LN",
+           "FixupResNet18", "FixupResNet50", "ResNet18", "ResNetTV",
+           "resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
+           "resnext50_32x4d", "resnext101_32x8d", "wide_resnet50_2",
+           "wide_resnet101_2", "ResNet101LN", "ResNet50LN",
            "ToyLinear", "TinyMLP"]

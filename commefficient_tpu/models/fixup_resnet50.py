@@ -1,0 +1,85 @@
+"""Self-contained BN-free Fixup ResNet-50 (ImageNet scale).
+
+The reference is a 10-line wrapper over the external ``fixup`` package's
+``FixupResNet``/``FixupBottleneck`` (reference models/fixup_resnet.py:8-10),
+named by the ImageNet reference configuration (reference imagenet.sh:2).
+This file implements the bottleneck Fixup rules self-containedly:
+
+* scalar biases around every conv (bias1a..bias3b), a scalar scale after
+  the last conv of each block
+* first two convs of a bottleneck ~ N(0, he_std * num_layers**-0.25)
+  (m=3 convs per branch => exponent -1/(2m-2) = -0.25), third conv zero
+* downsample conv reads the bias1a-shifted input; plain he init
+* zero-initialized classifier weight and bias
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from commefficient_tpu.models.fixup_resnet9 import _normal, _scalar
+
+
+def _he_std(c_out: int, k: int) -> float:
+    return float(np.sqrt(2.0 / (c_out * k * k)))
+
+
+class FixupBottleneck(nn.Module):
+    planes: int
+    stride: int = 1
+    num_layers: int = 16
+    expansion = 4
+
+    @nn.compact
+    def __call__(self, x):
+        out_ch = self.planes * self.expansion
+        b = {name: self.param(name, _scalar(0.0), (1,))
+             for name in ("bias1a", "bias1b", "bias2a", "bias2b",
+                          "bias3a", "bias3b")}
+        scale = self.param("scale", _scalar(1.0), (1,))
+        depth_scale = self.num_layers ** -0.25
+
+        out = nn.Conv(self.planes, (1, 1), use_bias=False,
+                      kernel_init=_normal(_he_std(self.planes, 1) *
+                                          depth_scale))(x + b["bias1a"])
+        out = nn.relu(out + b["bias1b"])
+        out = nn.Conv(self.planes, (3, 3), strides=self.stride, padding=1,
+                      use_bias=False,
+                      kernel_init=_normal(_he_std(self.planes, 3) *
+                                          depth_scale))(out + b["bias2a"])
+        out = nn.relu(out + b["bias2b"])
+        out = nn.Conv(out_ch, (1, 1), use_bias=False,
+                      kernel_init=nn.initializers.zeros)(out + b["bias3a"])
+        out = out * scale + b["bias3b"]
+
+        if self.stride != 1 or x.shape[-1] != out_ch:
+            identity = nn.Conv(
+                out_ch, (1, 1), strides=self.stride, use_bias=False,
+                kernel_init=_normal(_he_std(out_ch, 1)))(x + b["bias1a"])
+        else:
+            identity = x
+        return nn.relu(out + identity)
+
+
+class FixupResNet50(nn.Module):
+    num_classes: int = 1000
+    layers: tuple = (3, 4, 6, 3)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        num_layers = sum(self.layers)
+        x = nn.Conv(64, (7, 7), strides=2, padding=3, use_bias=False,
+                    kernel_init=_normal(_he_std(64, 7)))(x)
+        bias1 = self.param("bias1", _scalar(0.0), (1,))
+        x = nn.relu(x + bias1)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        planes = 64
+        for stage, n in enumerate(self.layers):
+            for i in range(n):
+                stride = 2 if (stage > 0 and i == 0) else 1
+                x = FixupBottleneck(planes, stride, num_layers)(x)
+            planes *= 2
+        x = jnp.mean(x, axis=(1, 2))
+        bias2 = self.param("bias2", _scalar(0.0), (1,))
+        return nn.Dense(self.num_classes, kernel_init=nn.initializers.zeros,
+                        bias_init=nn.initializers.zeros)(x + bias2)

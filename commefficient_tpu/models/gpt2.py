@@ -81,10 +81,12 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool):
-        h = nn.LayerNorm(dtype=self.dtype)(x)
+        # epsilon matches HF GPT-2 (1e-5) so imported pretrained weights
+        # reproduce reference logits (models/gpt2_import.py)
+        h = nn.LayerNorm(dtype=self.dtype, epsilon=1e-5)(x)
         x = x + CausalSelfAttention(self.n_head, self.dropout,
                                     self.dtype)(h, train)
-        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = nn.LayerNorm(dtype=self.dtype, epsilon=1e-5)(x)
         m = nn.Dense(4 * x.shape[-1], dtype=self.dtype,
                      kernel_init=nn.initializers.normal(0.02))(h)
         m = nn.gelu(m)
@@ -116,7 +118,7 @@ class GPT2DoubleHeads(nn.Module):
         x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
         for _ in range(cfg.n_layer):
             x = Block(cfg.n_head, cfg.dropout, cfg.jnp_dtype)(x, train)
-        x = nn.LayerNorm()(x.astype(jnp.float32))
+        x = nn.LayerNorm(epsilon=1e-5)(x.astype(jnp.float32))
 
         # LM head tied to wte (GPT-2 weight tying); logits in f32
         lm_logits = wte.attend(x)

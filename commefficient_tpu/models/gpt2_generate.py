@@ -1,0 +1,66 @@
+"""Qualitative reply generation for GPT2DoubleHeads.
+
+The reference's ``inference`` utility (reference gpt2_train.py:55-76) runs a
+no-grad forward for qualitative evaluation; interactive decoding lives in the
+upstream transfer-learning-conv-ai codebase this entrypoint descends from.
+Here: greedy or top-k sampled decoding over the PersonaChat input layout,
+built step by step with ``build_input_from_segments(..., with_eos=False)``.
+
+TPU note: the per-step forward is one jitted call on a static
+``max_seq_len`` buffer (the causal mask makes the padding tail invisible to
+the sampled position), so the whole decode costs ONE compilation; the
+token-append loop runs host-side, which is the right trade for a
+qualitative sample decoded once per training run.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from commefficient_tpu.data.persona import build_input_from_segments
+
+
+def sample_reply(model, params, tokenizer, persona: List[List[int]],
+                 history: List[List[int]], *, max_seq_len: int = 256,
+                 max_reply_len: int = 24, method: str = "greedy",
+                 top_k: int = 8, temperature: float = 0.7,
+                 seed: int = 0) -> List[int]:
+    """Decode a reply (token ids, no eos) for one persona/history context."""
+    if method not in ("greedy", "topk"):
+        raise ValueError(f"method must be 'greedy' or 'topk', got {method!r}")
+    eos = tokenizer.convert_tokens_to_ids("<eos>")
+
+    @jax.jit
+    def forward(p, ids, types, last_idx):
+        lm, _ = model.apply({"params": p}, ids[None, None], types[None, None],
+                            jnp.zeros((1, 1), jnp.int32), train=False)
+        return lm[0, 0, last_idx]
+
+    reply: List[int] = []
+    rng = jax.random.PRNGKey(seed)
+    for _ in range(max_reply_len):
+        inst = build_input_from_segments(persona, history, reply, tokenizer,
+                                         lm_labels=False, with_eos=False)
+        ids = inst["input_ids"][-max_seq_len:]
+        types = inst["token_type_ids"][-max_seq_len:]
+        L = len(ids)
+        ids_arr = np.zeros(max_seq_len, np.int32)
+        types_arr = np.zeros(max_seq_len, np.int32)
+        ids_arr[:L] = ids
+        types_arr[:L] = types
+        logits = forward(params, jnp.asarray(ids_arr),
+                         jnp.asarray(types_arr), jnp.int32(L - 1))
+        if method == "greedy":
+            nxt = int(jnp.argmax(logits))
+        else:
+            vals, idxs = jax.lax.top_k(logits / temperature, top_k)
+            rng, sub = jax.random.split(rng)
+            nxt = int(idxs[int(jax.random.categorical(sub, vals))])
+        if nxt == eos:
+            break
+        reply.append(nxt)
+    return reply

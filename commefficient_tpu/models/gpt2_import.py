@@ -1,0 +1,141 @@
+"""HF → flax GPT-2 pretrained-weight import.
+
+The reference *finetunes* HF-pretrained GPT2/OpenAIGPT on PersonaChat
+(reference gpt2_train.py:262-285: ``from_pretrained(args.model_checkpoint)``
+then ``add_special_tokens_`` resizes the embeddings). This module gives the
+TPU framework the same capability: map a locally-cached HF ``gpt2``
+state dict onto :class:`~commefficient_tpu.models.gpt2.GPT2DoubleHeads`
+params — wte/wpe/blocks/ln_f copied, the multiple-choice head left at its
+fresh init (it does not exist in the pretrained LM).
+
+Layout notes (verified by the logit-equivalence test in tests/test_gpt2.py):
+
+* HF ``Conv1D`` weights are already (in_features, out_features) — the same
+  orientation as a flax ``Dense`` kernel, so no transposes anywhere.
+* The fused qkv projection (``c_attn``) and our ``jnp.split(qkv, 3, -1)``
+  agree on the q|k|v concatenation order and per-head reshape layout.
+* Embedding tables may differ in row count (added special tokens; shorter
+  ``n_positions``): the overlapping prefix is copied, extra rows keep their
+  fresh init — the behavior of the reference's ``resize_token_embeddings``.
+* LayerNorm epsilon is 1e-5 in both models (gpt2.py sets it explicitly).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+
+def _copy_rows(dst: np.ndarray, src: np.ndarray) -> np.ndarray:
+    """Copy the overlapping leading rows of ``src`` into a copy of ``dst``."""
+    if dst.shape[1:] != src.shape[1:]:
+        raise ValueError(f"column shape mismatch: {dst.shape} vs {src.shape}")
+    out = np.array(dst, copy=True)
+    n = min(dst.shape[0], src.shape[0])
+    out[:n] = src[:n]
+    return out
+
+
+def import_hf_gpt2(params, state_dict: Dict[str, np.ndarray]):
+    """Return a copy of ``params`` with HF GPT-2 weights written in.
+
+    ``params``: the flax param tree of GPT2DoubleHeads (fresh init).
+    ``state_dict``: HF GPT2 state dict as numpy arrays, with or without the
+    ``transformer.`` prefix. ``mc_head`` is untouched. Raises KeyError when
+    an expected HF tensor is missing and ValueError on inner-shape mismatch.
+    """
+    sd = {k.removeprefix("transformer."): np.asarray(v, np.float32)
+          for k, v in state_dict.items()}
+
+    import jax
+    from flax.core import unfreeze
+    # unfreeze + tree_map yields fresh plain dicts at every level: safe to
+    # mutate in place without touching the caller's tree
+    p = jax.tree_util.tree_map(np.asarray, unfreeze(params))
+
+    def put(value, *path):
+        d = p
+        for key in path[:-1]:
+            d = d[key]
+        last = path[-1]
+        if d[last].shape != value.shape:
+            raise ValueError(
+                f"{'/'.join(path)}: model has {d[last].shape}, "
+                f"HF has {value.shape}")
+        d[last] = value
+
+    p["wte"]["embedding"] = _copy_rows(p["wte"]["embedding"],
+                                       sd["wte.weight"])
+    p["wpe"]["embedding"] = _copy_rows(p["wpe"]["embedding"],
+                                       sd["wpe.weight"])
+
+    n_layer = sum(1 for k in p if k.startswith("Block_"))
+    for i in range(n_layer):
+        b = f"Block_{i}"
+        h = f"h.{i}"
+        put(sd[f"{h}.ln_1.weight"], b, "LayerNorm_0", "scale")
+        put(sd[f"{h}.ln_1.bias"], b, "LayerNorm_0", "bias")
+        put(sd[f"{h}.attn.c_attn.weight"], b, "CausalSelfAttention_0",
+            "Dense_0", "kernel")
+        put(sd[f"{h}.attn.c_attn.bias"], b, "CausalSelfAttention_0",
+            "Dense_0", "bias")
+        put(sd[f"{h}.attn.c_proj.weight"], b, "CausalSelfAttention_0",
+            "Dense_1", "kernel")
+        put(sd[f"{h}.attn.c_proj.bias"], b, "CausalSelfAttention_0",
+            "Dense_1", "bias")
+        put(sd[f"{h}.ln_2.weight"], b, "LayerNorm_1", "scale")
+        put(sd[f"{h}.ln_2.bias"], b, "LayerNorm_1", "bias")
+        put(sd[f"{h}.mlp.c_fc.weight"], b, "Dense_0", "kernel")
+        put(sd[f"{h}.mlp.c_fc.bias"], b, "Dense_0", "bias")
+        put(sd[f"{h}.mlp.c_proj.weight"], b, "Dense_1", "kernel")
+        put(sd[f"{h}.mlp.c_proj.bias"], b, "Dense_1", "bias")
+
+    put(sd["ln_f.weight"], "LayerNorm_0", "scale")
+    put(sd["ln_f.bias"], "LayerNorm_0", "bias")
+    return p
+
+
+def load_hf_state_dict(model_checkpoint: str = "gpt2",
+                       verbose: bool = True) -> Optional[Dict[str, np.ndarray]]:
+    """The HF checkpoint's state dict from the local cache, or None.
+
+    Probe this FIRST (it is cheap relative to a GPT-2-small init) so the
+    caller only builds base params when there is something to import.
+    """
+    try:
+        from transformers import GPT2LMHeadModel
+        hf = GPT2LMHeadModel.from_pretrained(model_checkpoint,
+                                             local_files_only=True)
+    except Exception as e:
+        if verbose:
+            print(f"pretrained {model_checkpoint!r} not locally cached "
+                  f"({type(e).__name__}); training from scratch")
+        return None
+    return {k: v.detach().cpu().numpy() for k, v in hf.state_dict().items()}
+
+
+def try_load_hf_pretrained(params, model_checkpoint: str = "gpt2",
+                           verbose: bool = True) -> Optional[dict]:
+    """Import weights from a locally-cached HF checkpoint, or None.
+
+    Mirrors the reference's from_pretrained (gpt2_train.py:262-273) under
+    this environment's zero-egress constraint: a missing cache — or a cached
+    checkpoint whose dimensions don't fit the model (e.g. gpt2-medium into a
+    small config) — degrades to from-scratch training with a loud message,
+    never a crash or a silent download attempt.
+    """
+    sd = load_hf_state_dict(model_checkpoint, verbose=verbose)
+    if sd is None:
+        return None
+    try:
+        out = import_hf_gpt2(params, sd)
+    except (KeyError, ValueError) as e:
+        if verbose:
+            print(f"pretrained {model_checkpoint!r} does not fit this model "
+                  f"config ({e}); training from scratch")
+        return None
+    if verbose:
+        print(f"loaded pretrained HF {model_checkpoint!r} "
+              f"({sum(v.size for v in sd.values())} params)")
+    return out

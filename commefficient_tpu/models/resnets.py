@@ -62,16 +62,19 @@ class Bottleneck(nn.Module):
     planes: int
     stride: int = 1
     norm: str = "batch"
+    groups: int = 1            # ResNeXt cardinality (ref :310-334)
+    width_per_group: int = 64  # WideResNet doubles this (ref :336-370)
     expansion = 4
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        width = self.planes
+        width = int(self.planes * (self.width_per_group / 64.0)) * self.groups
         out_ch = self.planes * self.expansion
         out = nn.Conv(width, (1, 1), use_bias=False, kernel_init=_he)(x)
         out = nn.relu(_Norm(self.norm)(out, train))
         out = nn.Conv(width, (3, 3), strides=self.stride, padding=1,
-                      use_bias=False, kernel_init=_he)(out)
+                      use_bias=False, feature_group_count=self.groups,
+                      kernel_init=_he)(out)
         out = nn.relu(_Norm(self.norm)(out, train))
         out = nn.Conv(out_ch, (1, 1), use_bias=False, kernel_init=_he)(out)
         # zero-init the residual branch's last norm scale (the standard
@@ -128,6 +131,30 @@ def resnet101(**kw):
 
 def resnet152(**kw):
     return ResNetTV(block=Bottleneck, layers=(3, 8, 36, 3), **kw)
+
+
+def resnext50_32x4d(**kw):
+    """ResNeXt-50 32x4d (ref models/resnets.py:310-320)."""
+    return ResNetTV(block=partial(Bottleneck, groups=32, width_per_group=4),
+                    layers=(3, 4, 6, 3), **kw)
+
+
+def resnext101_32x8d(**kw):
+    """ResNeXt-101 32x8d (ref models/resnets.py:322-334)."""
+    return ResNetTV(block=partial(Bottleneck, groups=32, width_per_group=8),
+                    layers=(3, 4, 23, 3), **kw)
+
+
+def wide_resnet50_2(**kw):
+    """Wide ResNet-50-2: double bottleneck width (ref :336-352)."""
+    return ResNetTV(block=partial(Bottleneck, width_per_group=128),
+                    layers=(3, 4, 6, 3), **kw)
+
+
+def wide_resnet101_2(**kw):
+    """Wide ResNet-101-2 (ref :354-370)."""
+    return ResNetTV(block=partial(Bottleneck, width_per_group=128),
+                    layers=(3, 4, 23, 3), **kw)
 
 
 def ResNet101LN(**kw):

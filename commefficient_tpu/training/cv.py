@@ -143,7 +143,10 @@ def train(args, mesh=None, max_rounds=None, log=True):
 
     if args.do_checkpoint:
         from commefficient_tpu.utils.checkpoint import save_checkpoint
-        save_checkpoint(args.checkpoint_path, learner, args.model)
+        save_checkpoint(args.checkpoint_path, learner, args.model,
+                        meta={"model": args.model,
+                              "num_classes": num_classes,
+                              "do_batchnorm": args.do_batchnorm})
     return learner, row
 
 

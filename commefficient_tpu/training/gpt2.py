@@ -95,10 +95,31 @@ def train(args, max_rounds=None, log=True):
         def apply(self, *a, **k):
             return model.apply(*a, **k)
 
+    sample_in = (sample[0], sample[4], sample[1])
+    init_params = None
+    if args.model == "gpt2":
+        # finetune from HF-pretrained weights when a local cache exists
+        # (ref gpt2_train.py:262-285); requires the matching HF tokenizer —
+        # byte-level fallback vocab rows would misalign with BPE rows.
+        # Probe the cache BEFORE paying a 124M-param init for base params.
+        from commefficient_tpu.data.tokenizer import HFTokenizerWrapper
+        if isinstance(tokenizer, HFTokenizerWrapper):
+            from commefficient_tpu.models.gpt2_import import (
+                import_hf_gpt2, load_hf_state_dict)
+            sd = load_hf_state_dict(args.model_checkpoint)
+            if sd is not None:
+                base = model.init(jax.random.PRNGKey(args.seed), *sample_in,
+                                  train=False)["params"]
+                try:
+                    init_params = import_hf_gpt2(base, sd)
+                    print(f"loaded pretrained HF {args.model_checkpoint!r}")
+                except (KeyError, ValueError) as e:
+                    print(f"pretrained {args.model_checkpoint!r} does not "
+                          f"fit this model config ({e}); from scratch")
+
     learner = FedLearner(_Wrap(), cfg, loss_tr, loss_val,
-                         jax.random.PRNGKey(args.seed),
-                         (sample[0], sample[4], sample[1]),
-                         lr_schedule=sched)
+                         jax.random.PRNGKey(args.seed), sample_in,
+                         lr_schedule=sched, init_params=init_params)
 
     table = TableLogger() if log else None
     writer = None
@@ -147,9 +168,31 @@ def train(args, max_rounds=None, log=True):
         if writer:
             writer.close()
 
+    if log and not args.do_test:
+        _print_sample(args, model, learner, tokenizer, val_set)
     if args.do_checkpoint:
         save_pretrained(args.checkpoint_path, learner, gcfg, tokenizer)
     return learner, row
+
+
+def _print_sample(args, model, learner, tokenizer, val_set):
+    """Qualitative greedy sample at eval time (ref inference
+    gpt2_train.py:55-76)."""
+    try:
+        from commefficient_tpu.data.persona import tokenize_tree
+        from commefficient_tpu.models.gpt2_generate import sample_reply
+        raw = val_set._raw_dialogs()
+        d = raw.get("valid", raw.get("train"))[0]
+        utt = d["utterances"][0]
+        persona = tokenize_tree(d["personality"], tokenizer)
+        history = tokenize_tree(
+            utt["history"][-(2 * args.max_history + 1):], tokenizer)
+        reply = sample_reply(model, learner.params, tokenizer, persona,
+                             history, max_seq_len=args.max_seq_len)
+        print("context:", " / ".join(utt["history"][-2:]))
+        print("sample reply:", tokenizer.decode(reply))
+    except Exception as e:  # a qualitative nicety must not kill the run
+        print(f"generation sample skipped ({type(e).__name__}: {e})")
 
 
 def main(argv=None):

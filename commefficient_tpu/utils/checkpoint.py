@@ -12,6 +12,7 @@ at import time).
 
 from __future__ import annotations
 
+import json
 import os
 
 import jax
@@ -23,7 +24,10 @@ def _state_arrays(state):
     return flat, treedef
 
 
-def save_checkpoint(path: str, learner, name: str = "model") -> str:
+def save_checkpoint(path: str, learner, name: str = "model",
+                    meta: dict = None) -> str:
+    """``meta``: optional JSON-serializable model description (model name,
+    num_classes, ...) enabling cross-task finetune head swaps."""
     os.makedirs(path, exist_ok=True)
     fn = os.path.join(path, f"{name}.npz")
     flat, _ = _state_arrays(learner.state)
@@ -31,10 +35,11 @@ def save_checkpoint(path: str, learner, name: str = "model") -> str:
     # without reconstructing this run's FedState treedef (and without
     # storing the dominant array twice)
     widx = next(i for i, x in enumerate(flat) if x is learner.state.weights)
+    extra = {"meta": np.asarray(json.dumps(meta))} if meta else {}
     np.savez(fn, rounds_done=learner.rounds_done,
              total_download_bytes=learner.total_download_bytes,
              total_upload_bytes=learner.total_upload_bytes,
-             weights_idx=widx,
+             weights_idx=widx, **extra,
              **{f"arr_{i}": np.asarray(x) for i, x in enumerate(flat)})
     return fn
 

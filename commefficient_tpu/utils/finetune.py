@@ -63,6 +63,11 @@ def load_pretrained_for_finetune(module, rng, sample_input,
     in a FRESH head that alone stays trainable. Here: fresh-init the module,
     overwrite every non-head coordinate with the checkpointed weights, and
     return the head-only trainable mask for the round step.
+
+    Cross-task head swaps (different ``num_classes``) work when the
+    checkpoint carries model metadata (save_checkpoint's ``meta``): the
+    pretrained module is rebuilt, its flat vector unflattened, and body
+    leaves are restored per-path; only head-shaped leaves may differ.
     """
     if os.path.isdir(checkpoint_file):
         cands = sorted(f for f in os.listdir(checkpoint_file)
@@ -75,6 +80,8 @@ def load_pretrained_for_finetune(module, rng, sample_input,
                 f"{checkpoint_file} holds several checkpoints {cands}; "
                 "pass the specific .npz file")
         checkpoint_file = os.path.join(checkpoint_file, cands[0])
+    import json
+
     from commefficient_tpu.utils.params import flatten_params
     variables = module.init(rng, sample_input, train=False)
     params = variables["params"]
@@ -86,10 +93,62 @@ def load_pretrained_for_finetune(module, rng, sample_input,
                 f"{checkpoint_file} has no 'weights_idx' marker — re-save "
                 "with this version's save_checkpoint")
         saved = z[f"arr_{int(z['weights_idx'])}"]
-    if saved.shape != tuple(flat.shape):
+        meta = json.loads(str(z["meta"])) if "meta" in z.files else None
+
+    if saved.shape == tuple(flat.shape):
+        merged = jnp.where(head_mask > 0, flat,
+                           jnp.asarray(saved, flat.dtype))
+        return unflatten(merged), head_mask
+
+    # head-swap path: coordinate counts differ (e.g. CIFAR10 -> CIFAR100)
+    if meta is None:
         raise ValueError(
             f"pretrained weights have {saved.shape[0]} coordinates, model "
-            f"has {flat.shape[0]} — finetune requires the same architecture "
-            "(the head is re-initialized, not re-shaped)")
-    merged = jnp.where(head_mask > 0, flat, jnp.asarray(saved, flat.dtype))
-    return unflatten(merged), head_mask
+            f"has {flat.shape[0]}, and the checkpoint carries no model "
+            "metadata for a head swap — re-save with save_checkpoint(meta=...)")
+    from commefficient_tpu.models import get_model
+    old_kw = {"num_classes": meta["num_classes"]}
+    if meta.get("do_batchnorm") is not None and meta["model"] == "ResNet9":
+        old_kw["do_batchnorm"] = meta["do_batchnorm"]
+    old_module = get_model(meta["model"], **old_kw)
+    old_params = old_module.init(rng, sample_input, train=False)["params"]
+    old_flat, old_unflatten = flatten_params(old_params)
+    if saved.shape != tuple(old_flat.shape):
+        raise ValueError(
+            f"checkpoint meta {meta} rebuilds a model with "
+            f"{old_flat.shape[0]} coordinates but the saved vector has "
+            f"{saved.shape[0]} — metadata/weights mismatch")
+    old_tree = old_unflatten(jnp.asarray(saved, old_flat.dtype))
+    old_leaves = {tuple(str(getattr(q, "key", q)) for q in path): leaf
+                  for path, leaf in
+                  jax.tree_util.tree_flatten_with_path(old_tree)[0]}
+
+    flat_with_path, treedef = jax.tree_util.tree_flatten_with_path(params)
+    merged_leaves, not_restored = [], []
+    for path, leaf in flat_with_path:
+        key = tuple(str(getattr(q, "key", q)) for q in path)
+        old = old_leaves.get(key)
+        if old is not None and old.shape == leaf.shape:
+            merged_leaves.append(old)
+        else:
+            merged_leaves.append(leaf)  # fresh init (the swapped head)
+            not_restored.append("/".join(key))
+    # every non-restored leaf must be part of the trainable head, otherwise
+    # the "pretrained backbone" promise is silently broken
+    bad = [n for n in not_restored
+           if not _name_in_head(params, n, head_substring)]
+    if bad:
+        raise ValueError(
+            f"architecture mismatch beyond the head: {bad} have no "
+            "pretrained counterpart")
+    merged = jax.tree_util.tree_unflatten(treedef, merged_leaves)
+    return merged, head_mask
+
+
+def _name_in_head(params, name: str, head_substring: str) -> bool:
+    flat_with_path, _ = jax.tree_util.tree_flatten_with_path(params)
+    names = ["/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                      for p in path) for path, _ in flat_with_path]
+    head_names = [n.rsplit("/", 1)[0] for n in names if head_substring in n]
+    head = max(set(head_names), key=_module_sort_key)
+    return name.startswith(head)

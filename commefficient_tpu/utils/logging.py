@@ -73,6 +73,7 @@ class ScalarWriter:
             self._tb.add_scalar(tag, value, step)
         else:
             self._file.write(f"{step}\t{tag}\t{float(value)}\n")
+            self._file.flush()  # scalars trickle in; survive a killed run
 
     def close(self):
         if self._tb is not None:

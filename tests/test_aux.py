@@ -151,6 +151,37 @@ def test_load_pretrained_for_finetune(tmp_path):
     np.testing.assert_array_equal(np.asarray(flat), np.asarray(flat2))
 
 
+def test_finetune_head_swap_across_num_classes(tmp_path):
+    # pretrain with 2 classes, finetune with 3: body restored per-leaf from
+    # the checkpoint metadata, head fresh + alone trainable (the reference's
+    # primary finetune use, cv_train.py:377-384)
+    from commefficient_tpu.utils.finetune import load_pretrained_for_finetune
+    from commefficient_tpu.utils.params import flatten_params
+
+    xs = np.random.RandomState(0).randn(8, 6).astype(np.float32)
+    ys = (xs[:, 0] > 0).astype(np.int32)
+    cfg = FedConfig(mode="uncompressed", virtual_momentum=0, local_momentum=0,
+                    error_type="none", weight_decay=0, num_workers=1,
+                    num_clients=2, lr_scale=0.1)
+    pre_model = TinyMLP(num_classes=2)
+    pre = FedLearner(pre_model, cfg, make_cv_loss(pre_model), None,
+                     jax.random.PRNGKey(0), xs[:1])
+    pre.train_round(np.array([0]), (xs[None], ys[None]),
+                    np.ones((1, 8), np.float32))
+    fn = save_checkpoint(str(tmp_path), pre, "TinyMLP",
+                         meta={"model": "TinyMLP", "num_classes": 2})
+
+    new_model = TinyMLP(num_classes=3)
+    init_params, mask = load_pretrained_for_finetune(
+        new_model, jax.random.PRNGKey(7), xs[:1], fn)
+    new_flat, _ = flatten_params(init_params)
+    m = np.asarray(mask)
+    old_body = np.asarray(pre.state.weights)[
+        np.asarray(head_only_mask(pre.unflatten(pre.state.weights))) == 0]
+    np.testing.assert_array_equal(np.asarray(new_flat)[m == 0], old_body)
+    assert int(m.sum()) > 0
+
+
 def test_scalar_writer_tsv_roundtrip(tmp_path):
     from commefficient_tpu.utils.logging import ScalarWriter
     w = ScalarWriter(str(tmp_path / "run"))

@@ -102,6 +102,68 @@ def test_gpt2_causality():
                            np.asarray(lm2[0, 0, 11]))
 
 
+def test_sample_reply_greedy_and_topk():
+    from commefficient_tpu.models.gpt2_generate import sample_reply
+    tok = ByteTokenizer()
+    cfg = GPT2Config.tiny(vocab_size=tok.vocab_size)
+    model = GPT2DoubleHeads(cfg)
+    ids = np.zeros((1, 1, 8), np.int32)
+    params = model.init(jax.random.PRNGKey(0), ids, ids,
+                        np.zeros((1, 1), np.int32), train=False)["params"]
+    persona = [tok.encode("i like cats")]
+    history = [tok.encode("hello there")]
+    r1 = sample_reply(model, params, tok, persona, history,
+                      max_seq_len=64, max_reply_len=6)
+    r2 = sample_reply(model, params, tok, persona, history,
+                      max_seq_len=64, max_reply_len=6)
+    assert r1 == r2                      # greedy is deterministic
+    assert len(r1) <= 6
+    assert all(isinstance(t, int) for t in r1)
+    rt = sample_reply(model, params, tok, persona, history,
+                      max_seq_len=64, max_reply_len=6, method="topk",
+                      top_k=4, seed=3)
+    assert len(rt) <= 6
+    with pytest.raises(ValueError):
+        sample_reply(model, params, tok, persona, history,
+                     max_seq_len=64, method="beam")
+
+
+def test_hf_gpt2_import_logit_equivalence():
+    # map a RANDOM tiny HF GPT2 (built from config — no download) into
+    # GPT2DoubleHeads and require identical LM logits; also exercises the
+    # embedding-resize path (our vocab 100 > HF 96: prefix copied, new rows
+    # fresh — ref add_special_tokens_ gpt2_train.py:101-112)
+    transformers = pytest.importorskip("transformers")
+    torch = pytest.importorskip("torch")
+    from commefficient_tpu.models.gpt2_import import import_hf_gpt2
+
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=96, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+    torch.manual_seed(0)
+    hf = transformers.GPT2LMHeadModel(hf_cfg).eval()
+    sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+
+    cfg = GPT2Config(vocab_size=100, n_positions=64, n_embd=32, n_layer=2,
+                     n_head=4, dropout=0.0)
+    model = GPT2DoubleHeads(cfg)
+    rng = np.random.RandomState(1)
+    ids = rng.randint(0, 96, (2, 1, 10)).astype(np.int32)
+    types = rng.randint(0, 96, (2, 1, 10)).astype(np.int32)
+    mc = np.full((2, 1), 9, np.int32)
+    params = model.init(jax.random.PRNGKey(0), ids, types, mc,
+                        train=False)["params"]
+    mapped = import_hf_gpt2(params, sd)
+    lm, _ = model.apply({"params": mapped}, ids, types, mc, train=False)
+
+    with torch.no_grad():
+        ref = hf(input_ids=torch.tensor(ids[:, 0].astype(np.int64)),
+                 token_type_ids=torch.tensor(
+                     types[:, 0].astype(np.int64))).logits.numpy()
+    np.testing.assert_allclose(np.asarray(lm[:, 0, :, :96]), ref,
+                               atol=2e-4, rtol=2e-4)
+
+
 def test_gpt2_entrypoint_learns(tmp_path):
     from commefficient_tpu.training.gpt2 import main, train
     from commefficient_tpu.training.args import build_parser
