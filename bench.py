@@ -1,21 +1,48 @@
-"""Benchmark: federated rounds/sec for sketched FetchSGD, ResNet-9 @ CIFAR10
-shapes, on the attached TPU chip. Prints ONE JSON line.
+"""Benchmarks for the two north-star metrics (BASELINE.md):
 
-The metric matches BASELINE.json's north star ("CIFAR10 ResNet-9 fed
-rounds/sec"). One round = 8 simulated clients x 32 images each (256
-images/round), full FetchSGD pipeline: per-client grad, 5x500k CountSketch,
-aggregation, unsketch top-k=50k, error feedback — the reference's default
-sketch config (reference utils.py:142-145). The reference publishes no
-numbers (BASELINE.md), so vs_baseline is reported as 1.0 by convention.
+1. CIFAR10 ResNet-9 federated rounds/sec — full sketched FetchSGD pipeline
+   (8 clients x 32 images, default 5x500k sketch, k=50k: reference
+   utils.py:142-145), on the attached TPU chip.
+2. GPT2 PersonaChat tokens/sec/chip — gpt2-small double-heads federated
+   round on PersonaChat shapes (4 clients x 4 dialogs x 2 candidates x 256
+   tokens), bfloat16 compute, uncompressed mode (model-bound).
+
+Prints ONE JSON line: the primary metric fields plus ``extra_metrics`` and
+a per-component ``breakdown_ms`` of the sketch round (where the time goes:
+sketching the aggregate, unsketching, per-client grads).
+
+``--profile DIR`` wraps the timed rounds in ``jax.profiler.trace`` for
+TensorBoard inspection. The reference publishes no numbers (BASELINE.md),
+so vs_baseline is 1.0 by convention.
 """
 
+import argparse
 import json
 import time
 
 import numpy as np
 
 
-def main():
+def _sync(x):
+    """Force completion. block_until_ready is a no-op on the axon platform,
+    so pull ONE element to the host — sliced on-device first: np.asarray on
+    the full array would drag megabytes through the chip tunnel and swamp
+    the measurement."""
+    import jax.numpy as jnp
+    np.asarray(jnp.ravel(x)[0])
+
+
+def _time(fn, *args, n=10):
+    _sync(fn(*args))  # compile + warm
+    times = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        _sync(fn(*args))
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def bench_cifar_sketch():
     import jax
 
     from commefficient_tpu.config import FedConfig
@@ -43,19 +70,112 @@ def main():
 
     one_round(0)  # compile
     one_round(1)  # warm
-    n = 10
-    t0 = time.perf_counter()
-    for r in range(n):
-        out = one_round(2 + r)
-    jax.block_until_ready(learner.state.weights)
-    dt = time.perf_counter() - t0
+    # per-round times, median: the tunneled chip is shared and single
+    # measurement windows swing ~2x under contention
+    times = []
+    for r in range(12):
+        t0 = time.perf_counter()
+        one_round(2 + r)
+        _sync(learner.state.weights)
+        times.append(time.perf_counter() - t0)
+    round_time = float(np.median(times))
 
-    rounds_per_sec = n / dt
+    # component breakdown of where the round's time goes
+    from commefficient_tpu.federated.server import make_sketch
+    d = learner.cfg.grad_size  # finalized config carries the derived size
+    cs = make_sketch(learner.cfg)
+    vec = jax.numpy.asarray(rng.randn(d).astype(np.float32))
+    table = cs.sketch_vec(vec)
+    t_sketch = _time(cs.sketch_vec, vec)
+    t_unsketch = _time(cs.unsketch, table, cfg.k)
+    breakdown = {
+        "round_ms": round(round_time * 1e3, 1),
+        "sketch_aggregate_ms": round(t_sketch * 1e3, 1),
+        "unsketch_topk_ms": round(t_unsketch * 1e3, 1),
+        "grads_and_rest_ms": round(
+            max(round_time - t_sketch - t_unsketch, 0.0) * 1e3, 1),
+    }
+    return 1.0 / round_time, breakdown
+
+
+def bench_gpt2_tokens():
+    import jax
+
+    from commefficient_tpu.config import FedConfig
+    from commefficient_tpu.federated.api import FedLearner
+    from commefficient_tpu.federated.losses import make_gpt2_train_loss
+    from commefficient_tpu.models.gpt2 import GPT2Config, GPT2DoubleHeads
+
+    W, B, C, T = 4, 4, 2, 256
+    gcfg = GPT2Config.small(vocab_size=50262)
+    gcfg.n_positions = max(gcfg.n_positions, T)
+    gcfg.dropout = 0.1
+    gcfg.dtype = "bfloat16"  # MXU-native compute; params stay f32
+    model = GPT2DoubleHeads(gcfg)
+    cfg = FedConfig(mode="uncompressed", error_type="none",
+                    virtual_momentum=0.9, local_momentum=0, weight_decay=0,
+                    num_workers=W, num_clients=16, lr_scale=4e-2)
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 50000, (W, B, C, T)).astype(np.int32)
+    types = rng.randint(0, 3, (W, B, C, T)).astype(np.int32)
+    mc = np.full((W, B, C), T - 1, np.int32)
+    labels = np.where(rng.rand(W, B, C, T) < 0.3, ids, -1).astype(np.int32)
+    mcl = np.ones((W, B), np.int32)
+    mask = np.ones((W, B), np.float32)
+    batch = (ids, mc, labels, mcl, types)
+
+    class _Wrap:
+        def init(self, rng_, sample_in, train):
+            return model.init(rng_, *sample_in, train=train)
+
+        def apply(self, *a, **k):
+            return model.apply(*a, **k)
+
+    learner = FedLearner(
+        _Wrap(), cfg, make_gpt2_train_loss(model), None,
+        jax.random.PRNGKey(0), (ids[0][:1], types[0][:1], mc[0][:1]))
+
+    def one_round(r):
+        w_ids = (np.arange(W) + r * W) % cfg.num_clients
+        return learner.train_round(w_ids, batch, mask)
+
+    one_round(0)
+    one_round(1)
+    times = []
+    for r in range(8):
+        t0 = time.perf_counter()
+        one_round(2 + r)
+        _sync(learner.state.weights)
+        times.append(time.perf_counter() - t0)
+    round_time = float(np.median(times))
+    tokens_per_round = W * B * C * T
+    return tokens_per_round / round_time
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--profile", default=None,
+                    help="directory for a jax.profiler trace of the bench")
+    args = ap.parse_args()
+
+    from commefficient_tpu.utils.logging import profile_ctx
+
+    with profile_ctx(args.profile):
+        rounds_per_sec, breakdown = bench_cifar_sketch()
+        gpt2_tokens = bench_gpt2_tokens()
+
     print(json.dumps({
         "metric": "cifar10_resnet9_fed_rounds_per_sec",
         "value": round(rounds_per_sec, 4),
         "unit": "rounds/sec",
         "vs_baseline": 1.0,
+        "extra_metrics": [{
+            "metric": "gpt2_personachat_tokens_per_sec_chip",
+            "value": round(gpt2_tokens, 1),
+            "unit": "tokens/sec",
+        }],
+        "breakdown_ms": breakdown,
     }))
 
 

@@ -57,13 +57,19 @@ class FedImageNet(FedDataset):
         self._mmap_cache = {}
         self._val_targets = None
         # stats.json may predate the preprocess-once layout (older versions
-        # decoded JPEGs per batch); re-materialize the arrays if absent
-        if (self.train and len(self.images_per_client)
-                and not os.path.exists(self._client_fn(0))):
+        # decoded JPEGs per batch) or survive a crashed re-materialization;
+        # client files are written in order and stats.json is written last,
+        # so the LAST client file (plus the val arrays) is the completion
+        # proxy for an interrupted run
+        n_nat = len(self.images_per_client)
+        if (self.train and n_nat
+                and not os.path.exists(self._client_fn(n_nat - 1))):
             self.prepare_datasets()
         if (not self.train and self.num_val_images
-                and not os.path.exists(os.path.join(self.dataset_dir,
-                                                    "val_images.npy"))):
+                and not (os.path.exists(os.path.join(self.dataset_dir,
+                                                     "val_images.npy"))
+                         and os.path.exists(os.path.join(
+                             self.dataset_dir, "val_targets.npy")))):
             self.prepare_datasets()
 
     # --- preprocess-once --------------------------------------------------

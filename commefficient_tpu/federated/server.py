@@ -34,7 +34,7 @@ import jax.numpy as jnp
 from commefficient_tpu.config import FedConfig
 from commefficient_tpu.federated.state import ServerOptState
 from commefficient_tpu.ops.countsketch import CountSketch
-from commefficient_tpu.ops.topk import topk
+from commefficient_tpu.ops.topk import topk, topk_values_indices
 
 
 def init_server_opt_state(cfg: FedConfig) -> ServerOptState:
@@ -97,9 +97,12 @@ def _sketched(sketched_grad, state, cfg, lr, sketch: CountSketch):
     # 'virtual' accumulates; 'none' recovers straight from the momentum table
     # (sketch+'local' is rejected by FedConfig.validate)
     err = state.Verror + v if cfg.error_type == "virtual" else v
-    update = sketch.unsketch(err, cfg.k)
-    # the update's footprint *in sketch space* (re-sketch of the dense update)
-    sketched_update = sketch.sketch_vec(update)
+    vals, idxs = topk_values_indices(sketch.estimates(err), cfg.k)
+    update = jnp.zeros((cfg.grad_size,)).at[idxs].set(vals)
+    # the update's footprint *in sketch space*: re-sketching only the k
+    # nonzeros is bit-identical to sketching the dense update and ~130x
+    # cheaper at the default d=6.5M/k=50k (see CountSketch.sketch_sparse)
+    sketched_update = sketch.sketch_sparse(vals, idxs)
     support = sketched_update != 0
     if cfg.error_type == "virtual":
         err = jnp.where(support, 0.0, err)

@@ -29,3 +29,14 @@ def topk(vec: jax.Array, k: int) -> jax.Array:
     if vec.ndim == 2:
         return jax.vmap(_topk_1d, in_axes=(0, None))(vec, k)
     raise ValueError(f"topk supports 1-D/2-D inputs, got ndim={vec.ndim}")
+
+
+@partial(jax.jit, static_argnames="k")
+def topk_values_indices(vec: jax.Array, k: int):
+    """(values, indices) of the k largest-magnitude entries of a 1-D vector.
+
+    The sparse twin of ``topk``: same support, but handing back the k-sized
+    arrays lets callers re-sketch or transmit the update at O(k) instead of
+    O(d) (server._sketched re-sketches its top-k update this way)."""
+    _, idx = jax.lax.top_k(vec * vec, k)
+    return vec[idx], idx

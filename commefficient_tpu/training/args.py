@@ -21,6 +21,10 @@ def build_parser(default_lr: float = 0.4) -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=21)
     p.add_argument("--tensorboard", dest="use_tensorboard",
                    action="store_true")
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="write a jax.profiler trace of the training loop "
+                        "to DIR (the TPU analog of the reference's "
+                        "cProfile hooks, SURVEY.md §5)")
     # model/data
     p.add_argument("--model", default="ResNet9",
                    choices=sorted(MODEL_REGISTRY))

@@ -160,7 +160,9 @@ def main(argv=None):
         args.num_rows = min(args.num_rows, 1)
         args.num_epochs = 1
     np.random.seed(args.seed)
-    _, final = train(args)
+    from commefficient_tpu.utils.logging import profile_ctx
+    with profile_ctx(args.profile):
+        _, final = train(args)
     print("final:", {k: round(v, 4) if isinstance(v, float) else v
                      for k, v in final.items()})
     return 0

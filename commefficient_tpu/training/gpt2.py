@@ -146,9 +146,14 @@ def train(args, max_rounds=None, log=True):
             val = learner.evaluate(val_batches(val_set,
                                                args.valid_batch_size))
             # token-weighted nll = the reference's flat
-            # CrossEntropyLoss(ignore_index=-1) exactly (gpt2_train.py:77-87)
-            nll_tok = (float(val["metrics"][1]) /
-                       max(float(val["metrics"][2]), 1e-9))
+            # CrossEntropyLoss(ignore_index=-1) exactly (gpt2_train.py:77-87).
+            # An empty val split yields a placeholder metrics vector —
+            # fall back to the dialog-weighted loss channel then.
+            if np.size(val["metrics"]) >= 3:
+                nll_tok = (float(val["metrics"][1]) /
+                           max(float(val["metrics"][2]), 1e-9))
+            else:
+                nll_tok = float(val["loss"])
             row = {
                 "epoch": epoch + 1,
                 "lr": out["lr"],
@@ -220,7 +225,9 @@ def main(argv=None):
         args.num_cols = min(args.num_cols, 100)
         args.num_rows = min(args.num_rows, 1)
     np.random.seed(args.seed)
-    _, final = train(args)
+    from commefficient_tpu.utils.logging import profile_ctx
+    with profile_ctx(args.profile):
+        _, final = train(args)
     print("final:", {k: round(v, 4) if isinstance(v, float) else v
                      for k, v in final.items()})
     return 0

@@ -98,6 +98,16 @@ class Timer:
         return delta_t
 
 
+def profile_ctx(trace_dir):
+    """jax.profiler trace context, or a no-op when ``trace_dir`` is falsy
+    (the TPU analog of the reference's cProfile hooks, SURVEY.md §5)."""
+    import contextlib
+    if not trace_dir:
+        return contextlib.nullcontext()
+    import jax
+    return jax.profiler.trace(trace_dir)
+
+
 def make_logdir(cfg) -> str:
     """runs/<timestamp>_<workers>/<clients>_<mode> (ref utils.py:51-64)."""
     current_time = datetime.now().strftime("%b%d_%H-%M-%S")
