@@ -99,6 +99,12 @@ class FedImageNet(FedDataset):
         with ThreadPoolExecutor(max_workers=os.cpu_count()) as pool:
             for i, w in enumerate(wnids):
                 paths = sorted(glob.glob(os.path.join(train_dir, w, "*")))
+                # output is deterministic per wnid, so a client file that
+                # already exists (crash recovery re-run) is skipped rather
+                # than re-decoding hours of JPEGs
+                if os.path.exists(self._client_fn(i)):
+                    per_client.append(len(paths))
+                    continue
                 imgs = list(pool.map(lambda p: _decode_one(p, s), paths))
                 np.save(self._client_fn(i),
                         np.stack(imgs) if imgs

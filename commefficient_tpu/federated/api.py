@@ -68,9 +68,17 @@ class FedLearner:
     def lr_at(self, t: float) -> float:
         return float(self.lr_schedule(t))
 
-    def train_round(self, client_ids, batch, mask, epoch_frac=None):
-        """Run one federated round. Host-side metric rollup mirrors
-        run_batches (reference cv_train.py:171-252)."""
+    def train_round_async(self, client_ids, batch, mask, epoch_frac=None):
+        """Dispatch one federated round WITHOUT blocking on the result.
+
+        Returns the round's raw metrics as device arrays; pass them to
+        ``finalize_round_metrics`` when (if) host values are needed. Rounds
+        dispatched back-to-back pipeline on the device: batch upload and the
+        next round's dispatch overlap the current round's compute, so a
+        training loop that only finalizes metrics at logging points runs at
+        device throughput instead of round latency (the reference pays the
+        equivalent cost as blocking queue round-trips per round,
+        fed_aggregator.py:303-318)."""
         lr = self.lr_at(self.rounds_done if epoch_frac is None else epoch_frac)
         self.rng, round_rng = jax.random.split(self.rng)
         ids = jnp.asarray(client_ids, jnp.int32)
@@ -84,7 +92,16 @@ class FedLearner:
         self.state, metrics = self._round(self.state, ids, cols, m,
                                           lr, round_rng)
         self.rounds_done += 1
-        out = jax.device_get(metrics)
+        metrics["lr"] = lr
+        return metrics
+
+    def finalize_round_metrics(self, raw):
+        """Block on one round's device metrics and roll them up host-side
+        (mirrors run_batches, reference cv_train.py:171-252). Byte totals
+        accumulate here, so a loop must finalize every round's metrics
+        (in any order) for ``total_{down,up}load_bytes`` to be complete."""
+        lr = raw.pop("lr")
+        out = jax.device_get(raw)
         n = max(float(out["num_datapoints"]), 1.0)
         self.total_download_bytes += float(out["download_bytes"])
         self.total_upload_bytes += float(out["upload_bytes"])
@@ -97,6 +114,12 @@ class FedLearner:
             "update_l2": float(out["update_l2"]),
             "lr": lr,
         }
+
+    def train_round(self, client_ids, batch, mask, epoch_frac=None):
+        """Run one federated round and block for its metrics."""
+        return self.finalize_round_metrics(
+            self.train_round_async(client_ids, batch, mask,
+                                   epoch_frac=epoch_frac))
 
     def evaluate(self, batches: Iterable):
         """Centralized validation over an iterable of (batch_tuple, mask)."""

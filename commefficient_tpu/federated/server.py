@@ -100,8 +100,9 @@ def _sketched(sketched_grad, state, cfg, lr, sketch: CountSketch):
     vals, idxs = topk_values_indices(sketch.estimates(err), cfg.k)
     update = jnp.zeros((cfg.grad_size,)).at[idxs].set(vals)
     # the update's footprint *in sketch space*: re-sketching only the k
-    # nonzeros is bit-identical to sketching the dense update and ~130x
-    # cheaper at the default d=6.5M/k=50k (see CountSketch.sketch_sparse)
+    # nonzeros matches sketching the dense update (up to float summation
+    # order) and is ~130x cheaper at the default d=6.5M/k=50k
+    # (see CountSketch.sketch_sparse)
     sketched_update = sketch.sketch_sparse(vals, idxs)
     support = sketched_update != 0
     if cfg.error_type == "virtual":

@@ -148,9 +148,10 @@ class CountSketch:
     def sketch_sparse(self, values: jax.Array, indices: jax.Array) -> jax.Array:
         """Sketch a k-sparse vector given (values, coordinate indices).
 
-        Bit-identical to ``sketch_vec`` of the equivalent dense vector (the
-        d-k zeros contribute exactly 0.0 to every bucket) at O(r*k) instead
-        of O(r*d) — the win that makes re-sketching a top-k update ~free
+        Equivalent to ``sketch_vec`` of the dense vector (the d-k zeros
+        contribute 0.0 to every bucket) up to float32 summation order in
+        buckets where several nonzeros collide, at O(r*k) instead of
+        O(r*d) — the win that makes re-sketching a top-k update ~free
         (measured 330ms -> <5ms at d=6.5M, k=50k on a TPU chip)."""
         idx = indices.astype(jnp.int32)
 

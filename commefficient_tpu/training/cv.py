@@ -97,18 +97,35 @@ def train(args, mesh=None, max_rounds=None, log=True):
     try:
         for epoch in range(int(math.ceil(args.num_epochs))):
             epoch_metrics = []
-            for ids, cols, mask in batcher.epoch():
-                frac = total_rounds / max(spe, 1)
-                out = learner.train_round(ids, cols, mask, epoch_frac=frac)
-                total_rounds += 1
+            # one-round software pipeline: dispatch round r, then block on
+            # round r-1's metrics — the sync overlaps round r's device
+            # compute, so the loop runs at device throughput (bench.py's
+            # round_throughput_ms) instead of blocking latency. The NaN
+            # abort (ref cv_train.py:110-112) therefore lags one round.
+            pending = None
+
+            def drain(p):
+                out = learner.finalize_round_metrics(p)
                 epoch_metrics.append(out)
                 if not math.isfinite(out["loss"]) or \
                         out["loss"] > args.nan_threshold:
                     print(f"NaN/divergent loss ({out['loss']}); aborting "
                           f"(threshold {args.nan_threshold})")
-                    return learner, {"aborted": True, "loss": out["loss"]}
+                    return out
+                return None
+
+            for ids, cols, mask in batcher.epoch():
+                frac = total_rounds / max(spe, 1)
+                raw = learner.train_round_async(ids, cols, mask,
+                                                epoch_frac=frac)
+                total_rounds += 1
+                if pending is not None and (bad := drain(pending)):
+                    return learner, {"aborted": True, "loss": bad["loss"]}
+                pending = raw
                 if args.do_test or (max_rounds and total_rounds >= max_rounds):
                     break
+            if pending is not None and (bad := drain(pending)):
+                return learner, {"aborted": True, "loss": bad["loss"]}
             train_time = timer()
             val = learner.evaluate(val_batches(val_set,
                                                args.valid_batch_size))

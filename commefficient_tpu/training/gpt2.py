@@ -132,16 +132,29 @@ def train(args, max_rounds=None, log=True):
     try:
         for epoch in range(int(math.ceil(args.num_epochs))):
             losses = []
-            for ids, cols, mask in batcher.epoch():
-                out = learner.train_round(ids, cols, mask,
-                                          epoch_frac=total_rounds)
-                total_rounds += 1
+            # one-round pipeline (see training/cv.py): sync for round r-1
+            # overlaps round r's compute; NaN abort lags one round
+            pending, out = None, None
+
+            def drain(p):
+                nonlocal out
+                out = learner.finalize_round_metrics(p)
                 losses.append(out["loss"])
-                if not math.isfinite(out["loss"]):
+                return not math.isfinite(out["loss"])
+
+            for ids, cols, mask in batcher.epoch():
+                raw = learner.train_round_async(ids, cols, mask,
+                                                epoch_frac=total_rounds)
+                total_rounds += 1
+                if pending is not None and drain(pending):
                     print("NaN loss; aborting")
                     return learner, {"aborted": True}
+                pending = raw
                 if args.do_test or (max_rounds and total_rounds >= max_rounds):
                     break
+            if pending is not None and drain(pending):
+                print("NaN loss; aborting")
+                return learner, {"aborted": True}
             train_time = timer()
             val = learner.evaluate(val_batches(val_set,
                                                args.valid_batch_size))
