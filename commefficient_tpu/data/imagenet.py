@@ -99,16 +99,21 @@ class FedImageNet(FedDataset):
         with ThreadPoolExecutor(max_workers=os.cpu_count()) as pool:
             for i, w in enumerate(wnids):
                 paths = sorted(glob.glob(os.path.join(train_dir, w, "*")))
-                # output is deterministic per wnid, so a client file that
-                # already exists (crash recovery re-run) is skipped rather
-                # than re-decoding hours of JPEGs
+                # output is deterministic per wnid, so a complete client
+                # file (right count AND resolution — np.save is made atomic
+                # by the tmp+rename below, but stale sizes must not be
+                # reused) is skipped on a crash-recovery re-run rather than
+                # re-decoding hours of JPEGs
                 if os.path.exists(self._client_fn(i)):
-                    per_client.append(len(paths))
-                    continue
+                    arr = np.load(self._client_fn(i), mmap_mode="r")
+                    if arr.shape == (len(paths), s, s, 3):
+                        per_client.append(len(paths))
+                        continue
                 imgs = list(pool.map(lambda p: _decode_one(p, s), paths))
-                np.save(self._client_fn(i),
-                        np.stack(imgs) if imgs
+                tmp = self._client_fn(i) + ".tmp.npy"
+                np.save(tmp, np.stack(imgs) if imgs
                         else np.zeros((0, s, s, 3), np.uint8))
+                os.replace(tmp, self._client_fn(i))
                 per_client.append(len(imgs))
             # val streams straight into a memmap: 50k x 256^2 x 3 uint8 is
             # ~10 GB — materializing it in RAM first would double-OOM

@@ -121,6 +121,11 @@ class FedLearner:
             self.train_round_async(client_ids, batch, mask,
                                    epoch_frac=epoch_frac))
 
+    def pipeline(self) -> "RoundPipeline":
+        """A one-round software pipeline over this learner (see
+        ``RoundPipeline``)."""
+        return RoundPipeline(self)
+
     def evaluate(self, batches: Iterable):
         """Centralized validation over an iterable of (batch_tuple, mask)."""
         loss_sum, metric_sums, n_total = 0.0, None, 0.0
@@ -139,3 +144,33 @@ class FedLearner:
                 "metrics": (metric_sums if metric_sums is not None
                             else np.zeros(1)) / n,
                 "num_datapoints": n}
+
+
+class RoundPipeline:
+    """One-round software pipeline over a ``FedLearner``.
+
+    Feed each dispatched round's raw (device) metrics with ``push``; it
+    returns the PREVIOUS round's finalized metrics (or None for the first
+    round), so the host-side sync always overlaps the current round's
+    device compute. Call ``flush`` after the loop for the final round.
+    Training loops get device throughput instead of blocking latency while
+    keeping per-round metric visibility one round behind (which is why a
+    NaN abort driven by these metrics lags one round)."""
+
+    def __init__(self, learner: FedLearner):
+        self.learner = learner
+        self._pending = None
+
+    def push(self, raw):
+        out = None
+        if self._pending is not None:
+            out = self.learner.finalize_round_metrics(self._pending)
+        self._pending = raw
+        return out
+
+    def flush(self):
+        out = None
+        if self._pending is not None:
+            out = self.learner.finalize_round_metrics(self._pending)
+            self._pending = None
+        return out

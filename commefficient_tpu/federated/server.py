@@ -46,7 +46,8 @@ def init_server_opt_state(cfg: FedConfig) -> ServerOptState:
 def make_sketch(cfg: FedConfig) -> CountSketch:
     """Sketch with hashes shared by clients and server (ref args2sketch :464)."""
     return CountSketch(d=cfg.grad_size, c=cfg.num_cols, r=cfg.num_rows,
-                       seed=42, num_blocks=cfg.num_blocks)
+                       seed=42, num_blocks=cfg.num_blocks,
+                       scheme=cfg.sketch_scheme)
 
 
 def _momentum(gradient, velocity, rho):

@@ -43,6 +43,10 @@ def build_parser(default_lr: float = 0.4) -> argparse.ArgumentParser:
     p.add_argument("--num_cols", type=int, default=500000)
     p.add_argument("--num_rows", type=int, default=5)
     p.add_argument("--num_blocks", type=int, default=20)
+    p.add_argument("--sketch_scheme", choices=("tiled", "global"),
+                   default="tiled",
+                   help="tiled = TPU lane-tile windowed hashing (fast); "
+                        "global = classic per-coordinate hashing")
     p.add_argument("--topk_down", action="store_true", dest="do_topk_down")
     # optimization
     p.add_argument("--local_momentum", type=float, default=0.0)

@@ -97,15 +97,15 @@ def train(args, mesh=None, max_rounds=None, log=True):
     try:
         for epoch in range(int(math.ceil(args.num_epochs))):
             epoch_metrics = []
-            # one-round software pipeline: dispatch round r, then block on
-            # round r-1's metrics — the sync overlaps round r's device
-            # compute, so the loop runs at device throughput (bench.py's
-            # round_throughput_ms) instead of blocking latency. The NaN
-            # abort (ref cv_train.py:110-112) therefore lags one round.
-            pending = None
+            # one-round software pipeline (RoundPipeline): metric sync
+            # overlaps the next round's device compute, so the loop runs
+            # at device throughput (bench.py's round_throughput_ms). The
+            # NaN abort (ref cv_train.py:110-112) therefore lags one round.
+            pipe = learner.pipeline()
 
-            def drain(p):
-                out = learner.finalize_round_metrics(p)
+            def check(out):
+                if out is None:
+                    return None
                 epoch_metrics.append(out)
                 if not math.isfinite(out["loss"]) or \
                         out["loss"] > args.nan_threshold:
@@ -119,12 +119,11 @@ def train(args, mesh=None, max_rounds=None, log=True):
                 raw = learner.train_round_async(ids, cols, mask,
                                                 epoch_frac=frac)
                 total_rounds += 1
-                if pending is not None and (bad := drain(pending)):
+                if bad := check(pipe.push(raw)):
                     return learner, {"aborted": True, "loss": bad["loss"]}
-                pending = raw
                 if args.do_test or (max_rounds and total_rounds >= max_rounds):
                     break
-            if pending is not None and (bad := drain(pending)):
+            if bad := check(pipe.flush()):
                 return learner, {"aborted": True, "loss": bad["loss"]}
             train_time = timer()
             val = learner.evaluate(val_batches(val_set,

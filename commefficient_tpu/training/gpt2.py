@@ -132,27 +132,29 @@ def train(args, max_rounds=None, log=True):
     try:
         for epoch in range(int(math.ceil(args.num_epochs))):
             losses = []
-            # one-round pipeline (see training/cv.py): sync for round r-1
-            # overlaps round r's compute; NaN abort lags one round
-            pending, out = None, None
+            # one-round pipeline (RoundPipeline; see training/cv.py): sync
+            # for round r-1 overlaps round r's compute; NaN abort lags one
+            pipe = learner.pipeline()
+            out = None
 
-            def drain(p):
+            def check(o):
                 nonlocal out
-                out = learner.finalize_round_metrics(p)
-                losses.append(out["loss"])
-                return not math.isfinite(out["loss"])
+                if o is None:
+                    return False
+                out = o
+                losses.append(o["loss"])
+                return not math.isfinite(o["loss"])
 
             for ids, cols, mask in batcher.epoch():
                 raw = learner.train_round_async(ids, cols, mask,
                                                 epoch_frac=total_rounds)
                 total_rounds += 1
-                if pending is not None and drain(pending):
+                if check(pipe.push(raw)):
                     print("NaN loss; aborting")
                     return learner, {"aborted": True}
-                pending = raw
                 if args.do_test or (max_rounds and total_rounds >= max_rounds):
                     break
-            if pending is not None and drain(pending):
+            if check(pipe.flush()):
                 print("NaN loss; aborting")
                 return learner, {"aborted": True}
             train_time = timer()

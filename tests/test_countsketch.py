@@ -55,3 +55,87 @@ def test_table_accumulation_is_addition():
     t = cs.accumulate_vec(t, a)
     np.testing.assert_allclose(np.asarray(t),
                                np.asarray(2 * cs.sketch_vec(a)), rtol=1e-5)
+
+
+# --- tiled scheme specifics ------------------------------------------------
+
+def test_tiled_lossless_single_block():
+    # the XOR lane permutation makes same-block collisions impossible, so a
+    # d <= 128 vector round-trips exactly through any tiled sketch row
+    d = 100
+    cs = CountSketch(d=d, c=256, r=3, seed=11, scheme="tiled")
+    v = np.random.RandomState(2).randn(d).astype(np.float32)
+    est = np.asarray(cs.estimates(cs.sketch_vec(jnp.asarray(v))))
+    np.testing.assert_array_equal(est, v)
+
+
+def test_tiled_sparse_matches_dense():
+    # sketch_sparse must hit the same flat buckets as the dense tiled path
+    d, k = 5000, 64
+    cs = CountSketch(d=d, c=1000, r=5, seed=4, scheme="tiled")
+    rng = np.random.RandomState(7)
+    idx = rng.choice(d, k, replace=False).astype(np.int32)
+    vals = rng.randn(k).astype(np.float32)
+    dense = np.zeros(d, np.float32)
+    dense[idx] = vals
+    np.testing.assert_allclose(
+        np.asarray(cs.sketch_sparse(jnp.asarray(vals), jnp.asarray(idx))),
+        np.asarray(cs.sketch_vec(jnp.asarray(dense))), rtol=1e-5, atol=1e-6)
+
+
+def test_tiled_matches_global_recovery_quality():
+    # both schemes must recover planted heavy hitters from noise
+    d, k = 20_000, 50
+    rng = np.random.RandomState(9)
+    v = (rng.randn(d) * 0.01).astype(np.float32)
+    hot = rng.choice(d, k, replace=False)
+    v[hot] = 5.0 * np.sign(rng.randn(k)).astype(np.float32)
+    for scheme in ("tiled", "global"):
+        cs = CountSketch(d=d, c=5000, r=5, seed=6, scheme=scheme)
+        rec = np.asarray(cs.unsketch(cs.sketch_vec(jnp.asarray(v)), k))
+        found = np.intersect1d(np.nonzero(rec)[0], hot).size
+        assert found >= k - 2, (scheme, found)
+        # l2 estimate within 10%
+        l2 = float(cs.l2estimate(cs.sketch_vec(jnp.asarray(v))))
+        assert abs(l2 - np.linalg.norm(v)) / np.linalg.norm(v) < 0.1, scheme
+
+
+def test_tiled_table_is_padded():
+    cs = CountSketch(d=1000, c=500, r=2, seed=1, scheme="tiled")
+    assert cs.c_eff == 512
+    assert cs.zero_table().shape == (2, 512)
+    g = CountSketch(d=1000, c=500, r=2, seed=1, scheme="global")
+    assert g.c_eff == 500
+    # tiled and global are distinct cache keys for jit closures
+    assert cs != g and hash(cs) != hash(g)
+
+
+def test_tiled_routed_flat_and_chunked_bitexact(monkeypatch):
+    # The routed (one-hot lane routing, TPU) and flat (scatter/gather,
+    # CPU) implementations of the tiled scheme must be BIT-identical:
+    # the XOR lane permutation means each block contributes at most one
+    # value per bucket, so both sum buckets in block order. Likewise
+    # routing chunking (B > _CHUNK) must not change results.
+    import jax
+    from commefficient_tpu.ops import countsketch as m
+    d = 130 * m.LANES  # 130 blocks
+    v = jnp.asarray(np.random.RandomState(1).randn(d).astype(np.float32))
+
+    def run(routed, chunk):
+        monkeypatch.setattr(m.CountSketch, "_use_routed", lambda self: routed)
+        monkeypatch.setattr(m, "_CHUNK", chunk)
+        jax.clear_caches()  # equal sketches share jit traces
+        cs = CountSketch(d=d, c=4096, r=3, seed=8, scheme="tiled")
+        t = cs.sketch_vec(v)
+        return np.asarray(t), np.asarray(cs.estimates(t))
+
+    try:
+        t_flat, e_flat = run(routed=False, chunk=1024)
+        t_routed, e_routed = run(routed=True, chunk=1024)
+        t_chunked, e_chunked = run(routed=True, chunk=32)
+    finally:
+        jax.clear_caches()
+    np.testing.assert_array_equal(t_flat, t_routed)
+    np.testing.assert_array_equal(e_flat, e_routed)
+    np.testing.assert_array_equal(t_routed, t_chunked)
+    np.testing.assert_array_equal(e_routed, e_chunked)

@@ -210,7 +210,9 @@ def test_sketch_end_to_end_learns():
         last = ln.train_round(ids, batch, mask)
     assert last["loss"] < first["loss"] * 0.5
     assert last["metrics"][0] > 0.9  # accuracy
-    assert last["upload_bytes"] == 4.0 * 4 * 5 * 2000
+    # physical table: tiled scheme pads 2000 cols to 2048 (16 lane tiles)
+    assert ln.cfg.sketch_cols == 2048
+    assert last["upload_bytes"] == 4.0 * 4 * 5 * ln.cfg.sketch_cols
 
 
 def test_padded_worker_slots_are_inert():
