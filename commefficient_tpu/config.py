@@ -136,11 +136,11 @@ class FedConfig:
     @property
     def sketch_cols(self) -> int:
         """Physical sketch columns: the tiled scheme pads num_cols up to a
-        multiple of the lane tile (500_000 -> 500_096, +0.02%). Single
-        source of truth for the padding rule is ops.countsketch.LANES."""
+        multiple of the lane tile (500_000 -> 500_096, +0.02%). The padding
+        rule lives in ops.countsketch.pad_cols."""
         if self.sketch_scheme == "tiled":
-            from commefficient_tpu.ops.countsketch import LANES
-            return -(-self.num_cols // LANES) * LANES
+            from commefficient_tpu.ops.countsketch import pad_cols
+            return pad_cols(self.num_cols)
         return self.num_cols
 
     @property

@@ -105,8 +105,12 @@ class FedImageNet(FedDataset):
                 # reused) is skipped on a crash-recovery re-run rather than
                 # re-decoding hours of JPEGs
                 if os.path.exists(self._client_fn(i)):
-                    arr = np.load(self._client_fn(i), mmap_mode="r")
-                    if arr.shape == (len(paths), s, s, 3):
+                    try:
+                        arr = np.load(self._client_fn(i), mmap_mode="r")
+                        complete = arr.shape == (len(paths), s, s, 3)
+                    except (ValueError, OSError):
+                        complete = False  # truncated pre-atomic-write file
+                    if complete:
                         per_client.append(len(paths))
                         continue
                 imgs = list(pool.map(lambda p: _decode_one(p, s), paths))

@@ -19,8 +19,9 @@ from flax import struct
 class ServerOptState:
     """Virtual momentum / error vectors (ref fed_aggregator.py:408-409).
 
-    Shapes: ``(grad_size,)`` for dense modes, ``(num_rows, num_cols)`` for
-    sketch mode.
+    Shapes: ``(grad_size,)`` for dense modes, ``(num_rows, sketch_cols)``
+    for sketch mode (sketch_cols = num_cols padded to a lane tile under
+    the default tiled scheme; see FedConfig.sketch_cols).
     """
     Vvelocity: jax.Array
     Verror: jax.Array

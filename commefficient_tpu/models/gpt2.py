@@ -26,7 +26,9 @@ import numpy as np
 
 class GPT2Config:
     def __init__(self, vocab_size=50262, n_positions=512, n_embd=768,
-                 n_layer=12, n_head=12, dropout=0.1, dtype="float32"):
+                 n_layer=12, n_head=12, dropout=0.1, dtype="float32",
+                 attn_impl="full", attn_block_size=512, seq_axis="seq",
+                 remat=False):
         self.vocab_size = vocab_size
         self.n_positions = n_positions
         self.n_embd = n_embd
@@ -34,6 +36,20 @@ class GPT2Config:
         self.n_head = n_head
         self.dropout = dropout
         self.dtype = dtype  # "float32" | "bfloat16" compute dtype
+        # 'full' = materialized (T,T) scores; 'blockwise' = flash-style
+        # online softmax (O(T*block) memory, long-context single chip);
+        # 'ring' = sequence-parallel over ``seq_axis`` — the model must
+        # then be applied inside shard_map with T sharded on that axis
+        # (see ops/attention.py)
+        if attn_impl not in ("full", "blockwise", "ring"):
+            raise ValueError(f"unknown attn_impl {attn_impl!r}")
+        self.attn_impl = attn_impl
+        self.attn_block_size = attn_block_size
+        self.seq_axis = seq_axis
+        # rematerialize each transformer block on backward (jax.checkpoint):
+        # trades ~1/3 more FLOPs for O(n_layer) less activation memory —
+        # the standard TPU lever for long-context training
+        self.remat = remat
 
     @property
     def jnp_dtype(self):
@@ -54,21 +70,41 @@ class CausalSelfAttention(nn.Module):
     n_head: int
     dropout: float
     dtype: jnp.dtype = jnp.float32
+    attn_impl: str = "full"       # 'full' | 'blockwise' | 'ring'
+    attn_block_size: int = 512
+    seq_axis: str = "seq"
 
     @nn.compact
     def __call__(self, x, train: bool):
+        from commefficient_tpu.ops.attention import (blockwise_attention,
+                                                     ring_attention)
         B, T, C = x.shape
         qkv = nn.Dense(3 * C, dtype=self.dtype,
                        kernel_init=nn.initializers.normal(0.02))(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         heads = lambda t: t.reshape(B, T, self.n_head, C // self.n_head)
         q, k, v = heads(q), heads(k), heads(v)
-        att = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(C // self.n_head)
-        causal = jnp.tril(jnp.ones((T, T), bool))
-        att = jnp.where(causal[None, None], att, jnp.finfo(att.dtype).min)
-        att = jax.nn.softmax(att, axis=-1)
-        att = nn.Dropout(self.dropout, deterministic=not train)(att)
-        y = jnp.einsum("bhqk,bkhd->bqhd", att, v).reshape(B, T, C)
+        if self.attn_impl == "blockwise":
+            y = blockwise_attention(q, k, v, causal=True,
+                                    block_size=self.attn_block_size)
+            # flash-style impls don't support attention-prob dropout;
+            # apply it to the attention OUTPUT instead (documented
+            # divergence, ops/attention.py module docstring)
+            y = nn.Dropout(self.dropout, deterministic=not train)(y)
+        elif self.attn_impl == "ring":
+            # requires tracing inside shard_map with T sharded on seq_axis
+            y = ring_attention(q, k, v, axis_name=self.seq_axis, causal=True)
+            y = nn.Dropout(self.dropout, deterministic=not train)(y)
+        else:
+            att = (jnp.einsum("bqhd,bkhd->bhqk", q, k)
+                   / np.sqrt(C // self.n_head))
+            causal = jnp.tril(jnp.ones((T, T), bool))
+            att = jnp.where(causal[None, None], att,
+                            jnp.finfo(att.dtype).min)
+            att = jax.nn.softmax(att, axis=-1)
+            att = nn.Dropout(self.dropout, deterministic=not train)(att)
+            y = jnp.einsum("bhqk,bkhd->bqhd", att, v)
+        y = y.reshape(B, T, C)
         y = nn.Dense(C, dtype=self.dtype,
                      kernel_init=nn.initializers.normal(0.02))(y)
         return nn.Dropout(self.dropout, deterministic=not train)(y)
@@ -78,6 +114,9 @@ class Block(nn.Module):
     n_head: int
     dropout: float
     dtype: jnp.dtype = jnp.float32
+    attn_impl: str = "full"
+    attn_block_size: int = 512
+    seq_axis: str = "seq"
 
     @nn.compact
     def __call__(self, x, train: bool):
@@ -85,7 +124,9 @@ class Block(nn.Module):
         # reproduce reference logits (models/gpt2_import.py)
         h = nn.LayerNorm(dtype=self.dtype, epsilon=1e-5)(x)
         x = x + CausalSelfAttention(self.n_head, self.dropout,
-                                    self.dtype)(h, train)
+                                    self.dtype, self.attn_impl,
+                                    self.attn_block_size,
+                                    self.seq_axis)(h, train)
         h = nn.LayerNorm(dtype=self.dtype, epsilon=1e-5)(x)
         m = nn.Dense(4 * x.shape[-1], dtype=self.dtype,
                      kernel_init=nn.initializers.normal(0.02))(h)
@@ -113,11 +154,21 @@ class GPT2DoubleHeads(nn.Module):
         wpe = nn.Embed(cfg.n_positions, cfg.n_embd,
                        embedding_init=nn.initializers.normal(0.01),
                        name="wpe")
+        ring = cfg.attn_impl == "ring"
         pos = jnp.arange(T)[None, :]
+        if ring:
+            # inside shard_map T is the LOCAL sequence shard; positions
+            # (and the MC-head pick below) must be global
+            pos = pos + jax.lax.axis_index(cfg.seq_axis) * T
         x = wte(ids) + wpe(pos) + wte(types)
         x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
+        # static_argnums counts the flax scope as arg 0: train is arg 2
+        block_cls = (nn.remat(Block, static_argnums=(2,))
+                     if cfg.remat else Block)
         for _ in range(cfg.n_layer):
-            x = Block(cfg.n_head, cfg.dropout, cfg.jnp_dtype)(x, train)
+            x = block_cls(cfg.n_head, cfg.dropout, cfg.jnp_dtype,
+                          cfg.attn_impl, cfg.attn_block_size,
+                          cfg.seq_axis)(x, train)
         x = nn.LayerNorm(epsilon=1e-5)(x.astype(jnp.float32))
 
         # LM head tied to wte (GPT-2 weight tying); logits in f32
@@ -126,7 +177,17 @@ class GPT2DoubleHeads(nn.Module):
 
         # multiple-choice head: hidden state at each candidate's last token
         mc_ids = mc_token_ids.reshape(B * C)
-        picked = x[jnp.arange(B * C), mc_ids]          # (B*C, n_embd)
+        if ring:
+            # mc_token_ids are GLOBAL: the owning shard contributes its
+            # hidden state, psum replicates it everywhere
+            off = jax.lax.axis_index(cfg.seq_axis) * T
+            local = jnp.clip(mc_ids - off, 0, T - 1)
+            val = x[jnp.arange(B * C), local]
+            mine = (mc_ids >= off) & (mc_ids < off + T)
+            picked = jax.lax.psum(
+                jnp.where(mine[:, None], val, 0.0), cfg.seq_axis)
+        else:
+            picked = x[jnp.arange(B * C), mc_ids]      # (B*C, n_embd)
         picked = nn.Dropout(cfg.dropout, deterministic=not train)(picked)
         mc = nn.Dense(1, kernel_init=nn.initializers.normal(0.02),
                       name="mc_head")(picked)

@@ -82,6 +82,13 @@ _CHUNK = 1024      # blocks per routing chunk: bounds the (CHUNK, L, L)
                    # materializes it (CPU); fused away on TPU
 
 
+def pad_cols(c: int) -> int:
+    """Physical column count for the tiled scheme: c rounded up to a lane
+    tile. The single source of truth for the padding rule (used by both
+    CountSketch and FedConfig.sketch_cols)."""
+    return -(-int(c) // LANES) * LANES
+
+
 def _hash_coeffs(seed: int, r: int) -> tuple:
     rng = np.random.RandomState(seed)
     # 6 odd coefficients per row: h1..h4 for the sign polynomial, h5, h6 for
@@ -192,8 +199,8 @@ class CountSketch:
         if scheme == "tiled":
             self.nblocks = -(-self.d // LANES)
             self.d_pad = self.nblocks * LANES
-            self.nwindows = -(-self.c // LANES)
-            self.c_eff = self.nwindows * LANES
+            self.c_eff = pad_cols(self.c)
+            self.nwindows = self.c_eff // LANES
         else:
             self.c_eff = self.c
 

@@ -1,0 +1,61 @@
+"""Sequence-parallel (ring attention) application of GPT2DoubleHeads.
+
+The reference has no sequence parallelism (SURVEY.md §2: absent); here
+long-context is first-class: a GPT2 configured with ``attn_impl='ring'``
+runs its whole transformer trunk inside ``shard_map`` with the sequence
+dimension sharded over the mesh's ``seq`` axis. Attention keys/values
+rotate the ring via ``ppermute`` (ops/attention.py), positions and the
+MC-head pick use global offsets (models/gpt2.py), so the result matches
+the unsharded model to float tolerance — tested on an 8-device CPU mesh
+in tests/test_attention.py.
+
+Scaling story: per-device activation memory falls as T/n_seq, enabling
+contexts n_seq times longer than one chip's HBM allows; ring traffic rides
+ICI neighbor links and overlaps with per-block attention compute.
+
+Note on dropout: inside shard_map every shard derives the same rng from
+``rngs``, so dropout masks repeat across sequence shards (they would be
+independent unsharded). Use for eval/inference or with dropout=0 when
+exact training-distribution parity matters.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+
+def seq_parallel_apply(mesh, model, params, input_ids, token_type_ids,
+                       mc_token_ids, *, train: bool = False, rngs=None,
+                       axis_name: str = "seq"):
+    """Apply a ring-attention GPT2DoubleHeads with T sharded on ``axis_name``.
+
+    Args are global: input_ids/token_type_ids (B, C, T) with T divisible by
+    the mesh's seq-axis size; mc_token_ids (B, C) hold GLOBAL token
+    positions. Returns (lm_logits (B, C, T, V) sharded on T, mc_logits
+    (B, C) replicated).
+    """
+    if model.config.attn_impl != "ring":
+        raise ValueError("seq_parallel_apply requires attn_impl='ring' "
+                         f"(got {model.config.attn_impl!r})")
+    n_seq = mesh.shape[axis_name]
+    T = input_ids.shape[-1]
+    if T % n_seq:
+        raise ValueError(f"sequence length {T} not divisible by seq axis "
+                         f"size {n_seq}")
+
+    ids_spec = P(None, None, axis_name)
+    rep = P()
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(ids_spec, ids_spec, rep),
+             out_specs=(P(None, None, axis_name, None), rep),
+             check_rep=False)
+    def run(ids, types, mc_ids):
+        return model.apply({"params": params}, ids, types, mc_ids,
+                           train=train, rngs=rngs)
+
+    return run(input_ids, token_type_ids, mc_token_ids)

@@ -69,6 +69,9 @@ def train(args, max_rounds=None, log=True):
             if args.model == "gpt2" else
             GPT2Config.tiny(vocab_size=tokenizer.vocab_size))
     gcfg.n_positions = max(gcfg.n_positions, args.max_seq_len)
+    # 'blockwise' = flash-style O(T*block) attention for long sequences
+    # (ops/attention.py); 'full' matches the reference's materialized scores
+    gcfg.attn_impl = getattr(args, "attn_impl", "full")
     model = GPT2DoubleHeads(gcfg)
 
     batcher = FedBatcher(train_set, args.num_workers, args.local_batch_size,
@@ -225,6 +228,10 @@ def _print_sample(args, model, learner, tokenizer, val_set):
 def main(argv=None):
     parser = build_parser(default_lr=4e-2)  # ref gpt2_train.py:256
     parser.add_argument("--max_seq_len", type=int, default=256)
+    parser.add_argument("--attn_impl", choices=("full", "blockwise"),
+                        default="full",
+                        help="blockwise = flash-style O(T*block) memory "
+                             "for long sequences")
     for a in parser._actions:  # NLP model/dataset names join the CV choices
         if a.dest == "model":
             a.choices = sorted(set(a.choices) | {"gpt2", "gpt2-tiny"})
