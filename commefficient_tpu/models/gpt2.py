@@ -84,6 +84,10 @@ class CausalSelfAttention(nn.Module):
         q, k, v = jnp.split(qkv, 3, axis=-1)
         heads = lambda t: t.reshape(B, T, self.n_head, C // self.n_head)
         q, k, v = heads(q), heads(k), heads(v)
+        if self.attn_impl not in ("full", "blockwise", "ring"):
+            # post-construction assignment can bypass GPT2Config's check;
+            # never silently fall through to full attention
+            raise ValueError(f"unknown attn_impl {self.attn_impl!r}")
         if self.attn_impl == "blockwise":
             y = blockwise_attention(q, k, v, causal=True,
                                     block_size=self.attn_block_size)

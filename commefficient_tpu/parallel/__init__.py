@@ -1,5 +1,7 @@
+from commefficient_tpu.parallel import distributed
 from commefficient_tpu.parallel.mesh import (
     make_mesh, fed_state_shardings, batch_shardings, shard_state)
+from commefficient_tpu.parallel.seq import seq_parallel_apply
 
 __all__ = ["make_mesh", "fed_state_shardings", "batch_shardings",
-           "shard_state"]
+           "shard_state", "seq_parallel_apply", "distributed"]

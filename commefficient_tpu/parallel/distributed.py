@@ -1,0 +1,75 @@
+"""Multi-host initialization (the NCCL-process-group analog).
+
+The reference wires its "cluster" by hand: MASTER_ADDR=127.0.0.1, a free
+port found by random retries, and torch.distributed.init_process_group
+("nccl", rank, world_size) on the PS and every worker process (reference
+fed_aggregator.py:161-164, fed_worker.py:22-25, utils.py:217-223).
+
+On TPU pods the runtime already knows the topology: one JAX process per
+host calls ``jax.distributed.initialize()`` (zero-config on Cloud TPU;
+coordinator address/rank/size can be passed explicitly anywhere else) and
+``jax.devices()`` then spans every chip in the slice. Nothing else in this
+framework changes for multi-host: ``make_mesh`` builds the global mesh,
+FedState rows shard over it, and XLA routes collectives over ICI within a
+host's chips and DCN between hosts.
+
+Typical pod entrypoint::
+
+    from commefficient_tpu.parallel import distributed, make_mesh
+    distributed.initialize()            # once per host process
+    mesh = make_mesh()                  # all chips in the slice
+    learner = FedLearner(..., mesh=mesh)
+
+Every host must feed identical batches (same sampler seed) — the usual
+single-controller-per-host SPMD contract, matching the determinism the
+reference gets from shared seeds (cv_train.py:322-326).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Join the multi-host JAX cluster; no-op if already initialized or
+    running single-process.
+
+    With no arguments, relies on the TPU runtime's automatic discovery
+    (Cloud TPU metadata). Pass explicit values for other clusters — the
+    moral equivalent of the reference's MASTER_ADDR/rank/world_size, minus
+    the free-port hunting (utils.py:217-223)."""
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id)
+    except RuntimeError as e:
+        if "already initialized" in str(e).lower():
+            return
+        raise
+    except ValueError:
+        # no coordinator configured and none discoverable from the runtime
+        # (e.g. a single-host/CPU dev machine): single-process no-op
+        if coordinator_address is None and num_processes is None:
+            return
+        raise
+
+
+def is_multihost() -> bool:
+    return jax.process_count() > 1
+
+
+def local_worker_slice(num_workers: int) -> slice:
+    """This host's slice of the per-round worker batch, for feeding only
+    local shards when the batch is too large to replicate host-side."""
+    n = jax.process_count()
+    if num_workers % n:
+        raise ValueError(f"num_workers ({num_workers}) must be divisible "
+                         f"by process_count ({n})")
+    per = num_workers // n
+    i = jax.process_index()
+    return slice(i * per, (i + 1) * per)

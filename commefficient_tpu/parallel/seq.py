@@ -24,7 +24,7 @@ from __future__ import annotations
 from functools import partial
 
 import jax
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 
@@ -53,7 +53,7 @@ def seq_parallel_apply(mesh, model, params, input_ids, token_type_ids,
     @partial(shard_map, mesh=mesh,
              in_specs=(ids_spec, ids_spec, rep),
              out_specs=(P(None, None, axis_name, None), rep),
-             check_rep=False)
+             check_vma=False)
     def run(ids, types, mc_ids):
         return model.apply({"params": params}, ids, types, mc_ids,
                            train=train, rngs=rngs)

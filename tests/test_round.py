@@ -365,3 +365,35 @@ def test_eval_step():
     out = ln.evaluate([((X, Y), mask)])
     assert out["loss"] == pytest.approx(3.5, abs=1e-5)
     assert out["num_datapoints"] == 4.0
+
+
+def test_async_pipeline_matches_blocking():
+    # train_round_async + RoundPipeline must produce exactly the blocking
+    # train_round trajectory and complete byte totals
+    cfg = FedConfig(mode="true_topk", error_type="virtual",
+                    virtual_momentum=0.9, local_momentum=0, weight_decay=0,
+                    num_workers=2, num_clients=4, lr_scale=0.02, k=1)
+    ids, batch, mask = two_worker_batch()
+
+    ln_a = toy_learner(cfg, num_workers=2)
+    ln_b = toy_learner(cfg, num_workers=2)
+
+    outs_a = [ln_a.train_round(ids, batch, mask) for _ in range(4)]
+
+    pipe = ln_b.pipeline()
+    outs_b = []
+    for _ in range(4):
+        out = pipe.push(ln_b.train_round_async(ids, batch, mask))
+        if out is not None:
+            outs_b.append(out)
+    outs_b.append(pipe.flush())
+
+    assert len(outs_a) == len(outs_b)
+    for a, b in zip(outs_a, outs_b):
+        assert a["loss"] == b["loss"]
+        assert a["upload_bytes"] == b["upload_bytes"]
+        assert a["download_bytes"] == b["download_bytes"]
+    assert ln_a.total_upload_bytes == ln_b.total_upload_bytes
+    assert ln_a.total_download_bytes == ln_b.total_download_bytes
+    np.testing.assert_array_equal(np.asarray(ln_a.state.weights),
+                                  np.asarray(ln_b.state.weights))
