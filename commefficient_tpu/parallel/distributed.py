@@ -53,8 +53,17 @@ def initialize(coordinator_address: Optional[str] = None,
         raise
     except ValueError:
         # no coordinator configured and none discoverable from the runtime
-        # (e.g. a single-host/CPU dev machine): single-process no-op
+        # (e.g. a single-host/CPU dev machine): single-process fallback.
+        # Warn loudly — on a real pod this means the hosts will train
+        # INDEPENDENTLY, which is a silent correctness failure if intended
+        # as one job.
         if coordinator_address is None and num_processes is None:
+            import warnings
+            warnings.warn(
+                "jax.distributed.initialize found no coordinator; "
+                "continuing single-process. If this is a multi-host job, "
+                "pass coordinator_address/num_processes/process_id.",
+                RuntimeWarning)
             return
         raise
 

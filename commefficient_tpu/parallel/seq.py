@@ -24,6 +24,7 @@ from __future__ import annotations
 from functools import partial
 
 import jax
+import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
@@ -59,3 +60,61 @@ def seq_parallel_apply(mesh, model, params, input_ids, token_type_ids,
                            train=train, rngs=rngs)
 
     return run(input_ids, token_type_ids, mc_token_ids)
+
+
+def seq_dp_lm_train_step(mesh, model, params, input_ids, token_type_ids,
+                         labels, *, dp_axis: str = "clients",
+                         axis_name: str = "seq", train: bool = False,
+                         rngs=None):
+    """One data+sequence-parallel LM training step on a 2D mesh.
+
+    The composition the round engine uses for federated CV scaled to
+    long-context NLP: batch rows shard over ``dp_axis``, the sequence
+    shards over ``axis_name`` (ring attention inside the model), and
+    parameter gradients psum over BOTH axes — dp and sp in one SPMD
+    program, no pipeline stages or parameter servers.
+
+    Args are global: input_ids/token_type_ids/labels (B, C, T); B must
+    divide by the dp axis, T by the seq axis. ``labels`` use -1 for
+    positions that don't contribute (the caller pre-shifts next-token
+    targets so shard boundaries are correct: labels[t] = ids[t+1]).
+    Returns (mean nll over labeled tokens, grads pytree) — both
+    replicated.
+
+    ``train=True`` enables dropout (pass ``rngs={'dropout': key}``), with
+    the module-docstring caveat: masks repeat across sequence shards.
+    Default is eval-mode gradients (exact, dropout-free).
+    """
+    if model.config.attn_impl != "ring":
+        raise ValueError("seq_dp_lm_train_step requires attn_impl='ring'")
+    B, C, T = input_ids.shape
+    if B % mesh.shape[dp_axis] or T % mesh.shape[axis_name]:
+        raise ValueError(
+            f"batch {B} / seq {T} not divisible by mesh axes "
+            f"({mesh.shape[dp_axis]}, {mesh.shape[axis_name]})")
+    data_spec = P(dp_axis, None, axis_name)
+    mc_dummy = jnp.zeros((B, C), jnp.int32)
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P(), data_spec, data_spec, data_spec,
+                       P(dp_axis, None)),
+             out_specs=(P(), P()), check_vma=False)
+    def step(p, ids, types, labs, mc):
+        def local_loss(p):
+            lm, _ = model.apply({"params": p}, ids, types, mc,
+                                train=train, rngs=rngs)
+            lp = jax.nn.log_softmax(lm.astype(jnp.float32), axis=-1)
+            valid = labs >= 0
+            tgt = jnp.where(valid, labs, 0)
+            nll = -jnp.take_along_axis(lp, tgt[..., None], axis=-1)[..., 0]
+            return jnp.sum(nll * valid), jnp.sum(valid.astype(jnp.float32))
+
+        (loss_sum, n), grads = jax.value_and_grad(
+            local_loss, has_aux=True)(p)
+        total = jnp.maximum(jax.lax.psum(n, (dp_axis, axis_name)), 1.0)
+        grads = jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(g, (dp_axis, axis_name)) / total, grads)
+        loss = jax.lax.psum(loss_sum, (dp_axis, axis_name)) / total
+        return loss, grads
+
+    return step(params, input_ids, token_type_ids, labels, mc_dummy)
