@@ -82,8 +82,10 @@ def seq_dp_lm_train_step(mesh, model, params, input_ids, token_type_ids,
     replicated.
 
     ``train=True`` enables dropout (pass ``rngs={'dropout': key}``), with
-    the module-docstring caveat: masks repeat across sequence shards.
-    Default is eval-mode gradients (exact, dropout-free).
+    the module-docstring caveat extended to BOTH axes: the closed-over rng
+    is identical on every device, so masks repeat across sequence shards
+    AND across data-parallel shards (different batch rows get correlated
+    masks). Default is eval-mode gradients (exact, dropout-free).
     """
     if model.config.attn_impl != "ring":
         raise ValueError("seq_dp_lm_train_step requires attn_impl='ring'")

@@ -1,0 +1,67 @@
+"""Tensor parallelism for GPT2 — GSPMD parameter sharding.
+
+The reference has no tensor parallelism (SURVEY.md §2 parallelism
+checklist: absent); this is the TPU-native Megatron-style layout expressed
+the XLA way: annotate the weight shardings, let GSPMD insert the
+collectives. No manual all-reduces, no column/row-parallel layer classes —
+the same model code runs replicated or sharded.
+
+Layout per transformer block:
+* qkv projection kernel (C, 3C): sharded on the OUTPUT dim. The fused
+  layout means a contiguous shard straddles the q/k/v split boundaries,
+  so GSPMD re-partitions q/k/v to a head-sharded layout after the split
+  (one reshard per block — a true zero-comm Megatron layout would need a
+  head-interleaved qkv projection); the attention einsums themselves then
+  run sharded over heads.
+* attention output kernel (C, C): sharded on the INPUT dim — XLA closes
+  the block with one all-reduce.
+* MLP up (C, 4C) / down (4C, C): output- then input-sharded — the clean
+  Megatron property: one all-reduce per MLP, no comm in between.
+* Embeddings, layernorms, heads: replicated (vocab matmul is one matmul;
+  sharding it saves memory but costs an all-gather — not worth it at
+  GPT2-small scale).
+
+Use ``gpt2_tp_shardings`` to place params on a mesh with a ``model`` axis,
+then call the jitted apply with those shardings; works composed with the
+``clients`` data-parallel axis on a 2D mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _spec_for(path: tuple, leaf, axis: str) -> P:
+    names = [getattr(p, "key", str(p)) for p in path]
+    joined = "/".join(names)
+    if leaf.ndim == 2 and "Block_" in joined and "kernel" in names:
+        # inside a block: Dense_0 of attention = qkv (C, 3C) -> column;
+        # Dense_1 of attention = out proj (C, C) -> row;
+        # block-level Dense_0 = MLP up (C, 4C) -> column;
+        # block-level Dense_1 = MLP down (4C, C) -> row
+        if "CausalSelfAttention_0" in joined:
+            col = "Dense_0" in names
+        else:
+            col = leaf.shape[1] > leaf.shape[0]  # up-projection
+        return P(None, axis) if col else P(axis, None)
+    return P()  # embeddings, layernorms, biases, heads: replicated
+
+
+def gpt2_tp_specs(params, axis: str = "model"):
+    """PartitionSpec pytree for a GPT2DoubleHeads param tree."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: _spec_for(path, leaf, axis), params)
+
+
+def gpt2_tp_shardings(params, mesh: Mesh, axis: str = "model"):
+    """NamedSharding pytree; use with jax.device_put / jit in_shardings."""
+    return jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec),
+        gpt2_tp_specs(params, axis),
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def shard_params_tp(params, mesh: Mesh, axis: str = "model"):
+    """Place a replicated param tree onto the mesh in the TP layout."""
+    return jax.device_put(params, gpt2_tp_shardings(params, mesh, axis))

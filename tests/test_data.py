@@ -217,3 +217,74 @@ def test_imagenet_feed_outpaces_round_step(tiny_imagenet):
     # documents the comparison for the record.
     images_per_feed = 72  # 6 fetches x 12 images
     assert feed_time / images_per_feed < 0.015, (feed_time, round_time)
+
+
+def test_emnist_leaf_json_ingest(tmp_path):
+    # real LEAF format: {train,test}/*.json with users + user_data{x,y}
+    import json as _json
+    rng = np.random.RandomState(0)
+    for split, users in (("train", ["w0", "w1", "w2"]), ("test", ["w9"])):
+        d = tmp_path / split
+        d.mkdir()
+        blob = {"users": users, "user_data": {}}
+        for i, u in enumerate(users):
+            n = 3 + i
+            blob["user_data"][u] = {
+                "x": rng.rand(n, 784).round(3).tolist(),
+                "y": rng.randint(0, 62, n).tolist(),
+            }
+        with open(d / "shard0.json", "w") as f:
+            _json.dump(blob, f)
+
+    from commefficient_tpu.data import FedEMNIST
+    ds = FedEMNIST(dataset_dir=str(tmp_path), train=True,
+                   do_iid=False, num_clients=None, seed=0)
+    # natural partition: one LEAF writer per client, sizes 3,4,5
+    assert list(ds.images_per_client) == [3, 4, 5]
+    x, y = ds.get_flat_batch(np.asarray([3]))  # flat idx 3 = client 1, idx 0
+    assert x.shape == (1, 28, 28, 1)
+    assert 0 <= int(y[0]) < 62
+
+    val = FedEMNIST(dataset_dir=str(tmp_path), train=False, do_iid=False,
+                    num_clients=None, seed=0)
+    vx, vy = val.get_val_batch(np.asarray([0]))
+    assert vx.shape == (1, 28, 28, 1) and val.num_val_images == 3
+
+
+def test_persona_raw_json_ingest(tmp_path):
+    # real personachat_self_original.json structure: personality-per-client
+    import json as _json
+    raw = {"train": [], "valid": []}
+    for p in range(3):  # 3 personalities -> 3 natural clients
+        dialog = {
+            "personality": [f"i like thing {p} .", "i have a cat ."],
+            "utterances": [
+                {"candidates": ["wrong reply .", f"right reply {p} ."],
+                 "history": ["hello there ."]},
+                {"candidates": ["nope .", "yes indeed ."],
+                 "history": ["hello there .", f"right reply {p} .",
+                             "how are you ?"]},
+            ],
+        }
+        raw["train"].append(dialog)
+    raw["valid"].append(raw["train"][0])
+    with open(tmp_path / "personachat_self_original.json", "w") as f:
+        _json.dump(raw, f)
+
+    from commefficient_tpu.data.persona import FedPERSONA
+    ds = FedPERSONA(dataset_dir=str(tmp_path), train=True, do_iid=False,
+                    num_clients=None, seed=0, max_seq_len=128)
+    # one client per personality, 2 utterances each
+    assert ds.num_clients == 3
+    assert list(ds.images_per_client) == [2, 2, 2]
+    ids, mc_ids, lm_labels, mc_label, types = ds.get_flat_batch(
+        np.asarray([0]))
+    assert ids.shape == (1, 2, 128)    # (1, num_candidates, max_seq_len)
+    assert int(mc_label[0]) == 1       # last candidate is correct
+    # the correct candidate's tokens appear in the labeled region
+    assert (lm_labels[0, 1] >= 0).sum() > 0
+
+    val = FedPERSONA(dataset_dir=str(tmp_path), train=False, do_iid=False,
+                     num_clients=None, seed=0, max_seq_len=128)
+    vids, *_ = val.get_val_batch(np.asarray([0]))
+    assert vids.shape == (1, 2, 128) and val.num_val_images == 2
