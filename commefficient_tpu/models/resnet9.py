@@ -6,7 +6,11 @@ maxpool4, bias-free linear head, and the load-bearing 0.125 logit scale
 (reference resnet9.py:133 ``weight=0.125``). BatchNorm is optional and off by
 default (reference ``do_batchnorm=False``); convs are bias-free either way.
 
-TPU-first: NHWC layout, he_normal conv init, all static shapes.
+TPU-first: NHWC layout, he_normal conv init, all static shapes, and an
+optional bfloat16 compute dtype (``dtype="bfloat16"``): parameters and the
+returned logits stay float32 (so losses, gradients, and the compression
+pipeline are unchanged in type), while convs/matmuls run at full MXU rate.
+The reference trains float32 throughout; float32 remains the default.
 """
 
 from typing import Optional
@@ -17,19 +21,26 @@ import jax.numpy as jnp
 _conv_init = nn.initializers.he_normal()
 
 
+def _jnp_dtype(dtype):
+    return jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+
+
 class ConvBN(nn.Module):
     c_out: int
     do_batchnorm: bool = False
     pool: bool = False
     bn_weight_init: float = 1.0
+    dtype: str = "float32"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         x = nn.Conv(self.c_out, (3, 3), padding=1, use_bias=False,
+                    dtype=_jnp_dtype(self.dtype),
                     kernel_init=_conv_init)(x)
         if self.do_batchnorm:
             x = nn.BatchNorm(
                 use_running_average=not train, momentum=0.9,
+                dtype=_jnp_dtype(self.dtype),
                 scale_init=nn.initializers.constant(self.bn_weight_init),
             )(x)
         x = nn.relu(x)
@@ -41,11 +52,12 @@ class ConvBN(nn.Module):
 class Residual(nn.Module):
     c: int
     do_batchnorm: bool = False
+    dtype: str = "float32"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        y = ConvBN(self.c, self.do_batchnorm)(x, train)
-        y = ConvBN(self.c, self.do_batchnorm)(y, train)
+        y = ConvBN(self.c, self.do_batchnorm, dtype=self.dtype)(x, train)
+        y = ConvBN(self.c, self.do_batchnorm, dtype=self.dtype)(y, train)
         # reference Residual: x + relu(res2(res1(x))) (resnet9.py:68); relu
         # is already applied inside ConvBN, so this is x + res2(res1(x))
         return x + y
@@ -56,20 +68,24 @@ class ResNet9(nn.Module):
     do_batchnorm: bool = False
     logit_weight: float = 0.125
     channels: Optional[dict] = None  # input channels are inferred from x
+    dtype: str = "float32"           # compute dtype; params stay float32
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         ch = self.channels or {"prep": 64, "layer1": 128,
                                "layer2": 256, "layer3": 512}
         bn = self.do_batchnorm
-        x = ConvBN(ch["prep"], bn)(x, train)
-        x = ConvBN(ch["layer1"], bn, pool=True)(x, train)
-        x = Residual(ch["layer1"], bn)(x, train)
-        x = ConvBN(ch["layer2"], bn, pool=True)(x, train)
-        x = ConvBN(ch["layer3"], bn, pool=True)(x, train)
-        x = Residual(ch["layer3"], bn)(x, train)
+        dt = self.dtype
+        x = x.astype(_jnp_dtype(dt))
+        x = ConvBN(ch["prep"], bn, dtype=dt)(x, train)
+        x = ConvBN(ch["layer1"], bn, pool=True, dtype=dt)(x, train)
+        x = Residual(ch["layer1"], bn, dtype=dt)(x, train)
+        x = ConvBN(ch["layer2"], bn, pool=True, dtype=dt)(x, train)
+        x = ConvBN(ch["layer3"], bn, pool=True, dtype=dt)(x, train)
+        x = Residual(ch["layer3"], bn, dtype=dt)(x, train)
         x = nn.max_pool(x, (4, 4), strides=(4, 4))
         x = x.reshape((x.shape[0], -1))
         x = nn.Dense(self.num_classes, use_bias=False,
+                     dtype=_jnp_dtype(dt),
                      kernel_init=nn.initializers.lecun_normal())(x)
-        return x * self.logit_weight
+        return x.astype(jnp.float32) * self.logit_weight

@@ -1,6 +1,7 @@
 from commefficient_tpu.parallel import distributed
 from commefficient_tpu.parallel.mesh import (
     make_mesh, fed_state_shardings, batch_shardings, shard_state)
+from commefficient_tpu.parallel.pp import gpt2_pp_lm_apply
 from commefficient_tpu.parallel.seq import (seq_dp_lm_train_step,
                                             seq_parallel_apply)
 from commefficient_tpu.parallel.tp import (gpt2_tp_shardings, gpt2_tp_specs,
@@ -9,4 +10,5 @@ from commefficient_tpu.parallel.tp import (gpt2_tp_shardings, gpt2_tp_specs,
 __all__ = ["make_mesh", "fed_state_shardings", "batch_shardings",
            "shard_state", "seq_parallel_apply", "seq_dp_lm_train_step",
            "gpt2_tp_specs", "gpt2_tp_shardings", "shard_params_tp",
+           "gpt2_pp_lm_apply",
            "distributed"]

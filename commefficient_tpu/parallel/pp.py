@@ -1,0 +1,157 @@
+"""Pipeline parallelism for GPT2 — GPipe-style stages over a ``stage`` axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2 parallelism
+checklist: absent). This is the TPU-native formulation: transformer blocks
+are HOMOGENEOUS, so the trunk stacks into a (n_layer, ...) parameter
+pytree, stages are contiguous layer groups sharded over a ``stage`` mesh
+axis, and the GPipe schedule is a ``lax.fori_loop`` whose carried
+activations ``ppermute`` one hop down the ring each tick. Microbatches
+enter at stage 0; after ``n_micro + n_stage - 1`` ticks every microbatch
+has crossed every stage (the classic bubble). Embeddings and the LM head
+are cheap and replicated: every device embeds, only stage 0's embedding
+enters the pipe; every device computes the head, only the last stage's
+logits are real (selected by masking, then summed over the stage axis —
+each position has exactly one real contributor).
+
+Autodiff: ``jax.grad`` differentiates straight through the loop —
+``ppermute``'s transpose is the reverse permute, so the backward pass is
+automatically the reverse pipeline. Gradients for each stage's block
+parameters land on that stage's shard; psum them over ``stage`` only if a
+replicated optimizer step is wanted (grads for the stacked trunk are
+disjoint across stages, so the psum is exact, not an average).
+
+This module exposes LM-forward machinery sufficient for training loops
+and tests; the double-heads MC pick is intentionally out of scope (the
+reference's PersonaChat MC task uses short sequences where PP is
+pointless; PP targets deep-trunk LM work).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from commefficient_tpu.models.gpt2 import Block, GPT2Config
+
+
+def stack_block_params(params, n_layer: int):
+    """Restructure {Block_0..Block_{L-1}: tree} into one stacked tree with a
+    leading (L, ...) layer axis, plus the non-block remainder."""
+    blocks = [params[f"Block_{i}"] for i in range(n_layer)]
+    stacked = jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *blocks)
+    rest = {k: v for k, v in params.items() if not k.startswith("Block_")}
+    return stacked, rest
+
+
+def gpt2_pp_lm_apply(mesh, model, params, input_ids, token_type_ids,
+                     n_micro: int, *, axis_name: str = "stage"):
+    """LM logits via a GPipe pipeline over ``axis_name``.
+
+    ``input_ids``/``token_type_ids`` are (B, T) with B divisible by
+    ``n_micro``; blocks split into ``mesh.shape[axis_name]`` contiguous
+    stages. Returns (B, T, vocab) float32 logits, replicated. Matches the
+    plain forward to float tolerance (tests/test_attention.py).
+    """
+    cfg: GPT2Config = model.config
+    if cfg.attn_impl == "ring":
+        # ring needs a live 'seq' axis inside the pipe; not composed here
+        raise ValueError("gpt2_pp_lm_apply supports attn_impl "
+                         "'full'/'blockwise', not 'ring'")
+    S = mesh.shape[axis_name]
+    L = cfg.n_layer
+    if L % S:
+        raise ValueError(f"n_layer ({L}) must divide by stages ({S})")
+    B, T = input_ids.shape
+    if B % n_micro:
+        raise ValueError(f"batch ({B}) must divide by n_micro ({n_micro})")
+    per_stage = L // S
+    mb = B // n_micro
+
+    stacked, rest = stack_block_params(params, L)
+    # (S, per_stage, ...) — stage axis sharded, layer-within-stage local
+    staged = jax.tree_util.tree_map(
+        lambda leaf: leaf.reshape((S, per_stage) + leaf.shape[1:]), stacked)
+
+    # honor the model config: blockwise (flash) attention composes with PP
+    # for long context, and cfg.remat rematerializes each layer on backward
+    block = Block(cfg.n_head, cfg.dropout, cfg.jnp_dtype, cfg.attn_impl,
+                  cfg.attn_block_size, cfg.seq_axis)
+
+    def apply_layer(layer_params, h):
+        return block.apply({"params": layer_params}, h, False)
+
+    if cfg.remat:
+        apply_layer = jax.checkpoint(apply_layer)
+
+    def run_stage(stage_params, x):
+        """Apply this stage's per_stage blocks to x (mb, T, C)."""
+        def body(h, layer_params):
+            return apply_layer(layer_params, h), None
+        h, _ = jax.lax.scan(body, x, stage_params)
+        return h
+
+    staged_spec = jax.tree_util.tree_map(
+        lambda _: P(axis_name), staged)
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(staged_spec, P(), P(), P()),
+             out_specs=P(), check_vma=False)
+    def pipe(stage_params, ids, types, pos_embed_inputs):
+        my = jax.lax.axis_index(axis_name)
+        # local stage params: (1, per_stage, ...) -> (per_stage, ...)
+        local = jax.tree_util.tree_map(lambda leaf: leaf[0], stage_params)
+
+        # every device embeds (cheap, replicated weights)
+        wte, wpe = pos_embed_inputs
+        pos = jnp.arange(T)[None, :]
+        emb = (jnp.take(wte, ids, axis=0) + jnp.take(wpe, pos, axis=0)
+               + jnp.take(wte, types, axis=0))          # (B, T, C)
+        micro = emb.reshape(n_micro, mb, T, -1)
+
+        n_tick = n_micro + S - 1
+        perm = [(i, (i + 1) % S) for i in range(S)]
+        C = emb.shape[-1]
+        carry0 = jnp.zeros((mb, T, C), emb.dtype)
+        outs0 = jnp.zeros((n_micro, mb, T, C), jnp.float32)
+
+        def tick(t, state):
+            carry, outs = state
+            # stage 0 ingests microbatch t (if any remain); others use the
+            # activation ppermuted from the previous stage
+            feed = micro[jnp.minimum(t, n_micro - 1)]
+            x = jnp.where(my == 0, feed, carry)
+            y = run_stage(local, x)
+            # the LAST stage finished microbatch (t - (S-1)) at tick t
+            done_idx = t - (S - 1)
+            is_done = jnp.logical_and(my == S - 1, done_idx >= 0)
+            outs = jax.lax.cond(
+                is_done,
+                lambda o: o.at[jnp.maximum(done_idx, 0)].set(
+                    y.astype(jnp.float32)),
+                lambda o: o, outs)
+            carry = jax.lax.ppermute(y, axis_name, perm)
+            return carry, outs
+
+        _, outs = jax.lax.fori_loop(0, n_tick, tick, (carry0, outs0))
+        # only the last stage wrote real outputs; replicate via psum
+        # (every other stage contributes zeros)
+        outs = jax.lax.psum(
+            jnp.where(my == S - 1, outs, 0.0), axis_name)
+        return outs.reshape(B, T, C)
+
+    wte = params["wte"]["embedding"]
+    wpe = params["wpe"]["embedding"]
+    # jit: required for remat (closed_call) under shard_map, and fuses the
+    # whole pipeline schedule into one XLA program
+    x = jax.jit(pipe)(staged, input_ids, token_type_ids, (wte, wpe))
+
+    # final LN + tied LM head (replicated, outside the pipe)
+    x = nn.LayerNorm(epsilon=1e-5).apply(
+        {"params": params["LayerNorm_0"]}, x.astype(jnp.float32))
+    return jnp.einsum("btd,vd->btv", x, wte.astype(jnp.float32))

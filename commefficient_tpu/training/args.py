@@ -43,6 +43,9 @@ def build_parser(default_lr: float = 0.4) -> argparse.ArgumentParser:
     p.add_argument("--num_cols", type=int, default=500000)
     p.add_argument("--num_rows", type=int, default=5)
     p.add_argument("--num_blocks", type=int, default=20)
+    p.add_argument("--compute_dtype", choices=("float32", "bfloat16"),
+                   default="float32",
+                   help="model compute dtype (params stay float32)")
     p.add_argument("--sketch_scheme", choices=("tiled", "global"),
                    default="tiled",
                    help="tiled = TPU lane-tile windowed hashing (fast); "

@@ -50,8 +50,16 @@ def build_learner(args, sample_input, num_classes, channels, mesh=None):
                          num_channels=channels,
                          num_clients=args.num_clients)
     model_kw = dict(num_classes=num_classes)
+    compute_dtype = getattr(args, "compute_dtype", "float32")
     if args.model in ("ResNet9",):
         model_kw["do_batchnorm"] = args.do_batchnorm
+        # bf16 convs at full MXU rate; params/logits stay f32 (the
+        # reference trains f32 — that stays the default)
+        model_kw["dtype"] = compute_dtype
+    elif compute_dtype != "float32":
+        # never let the flag silently no-op
+        raise ValueError(f"--compute_dtype {compute_dtype} is only "
+                         f"supported for ResNet9 (got {args.model})")
     # input channel count is inferred by flax from the sample input; no
     # per-model stem flag needed (1-channel EMNIST just works)
     model = get_model(args.model, **model_kw)

@@ -72,6 +72,8 @@ def train(args, max_rounds=None, log=True):
     # 'blockwise' = flash-style O(T*block) attention for long sequences
     # (ops/attention.py); 'full' matches the reference's materialized scores
     gcfg.attn_impl = getattr(args, "attn_impl", "full")
+    # bf16 matmuls (params and logits stay f32); reference default is f32
+    gcfg.dtype = getattr(args, "compute_dtype", "float32")
     model = GPT2DoubleHeads(gcfg)
 
     batcher = FedBatcher(train_set, args.num_workers, args.local_batch_size,
