@@ -50,6 +50,11 @@ class GPT2Config:
         # trades ~1/3 more FLOPs for O(n_layer) less activation memory —
         # the standard TPU lever for long-context training
         self.remat = remat
+        # >0 replaces every block's MLP with a Switch-style MoE of this
+        # many experts (ops/moe.py); stacked expert weights are the
+        # expert-parallel axis. 0 = dense MLP (reference parity).
+        self.moe_experts = 0
+        self.moe_capacity_factor = 1.25
 
     @property
     def jnp_dtype(self):
@@ -121,6 +126,8 @@ class Block(nn.Module):
     attn_impl: str = "full"
     attn_block_size: int = 512
     seq_axis: str = "seq"
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, x, train: bool):
@@ -132,11 +139,17 @@ class Block(nn.Module):
                                     self.attn_block_size,
                                     self.seq_axis)(h, train)
         h = nn.LayerNorm(dtype=self.dtype, epsilon=1e-5)(x)
-        m = nn.Dense(4 * x.shape[-1], dtype=self.dtype,
-                     kernel_init=nn.initializers.normal(0.02))(h)
-        m = nn.gelu(m)
-        m = nn.Dense(x.shape[-1], dtype=self.dtype,
-                     kernel_init=nn.initializers.normal(0.02))(m)
+        if self.moe_experts > 0:
+            from commefficient_tpu.ops.moe import MoEFFN
+            m = MoEFFN(self.moe_experts, 4 * x.shape[-1],
+                       self.moe_capacity_factor, self.dtype,
+                       name="moe")(h)
+        else:
+            m = nn.Dense(4 * x.shape[-1], dtype=self.dtype,
+                         kernel_init=nn.initializers.normal(0.02))(h)
+            m = nn.gelu(m)
+            m = nn.Dense(x.shape[-1], dtype=self.dtype,
+                         kernel_init=nn.initializers.normal(0.02))(m)
         return x + nn.Dropout(self.dropout, deterministic=not train)(m)
 
 
@@ -172,7 +185,8 @@ class GPT2DoubleHeads(nn.Module):
         for _ in range(cfg.n_layer):
             x = block_cls(cfg.n_head, cfg.dropout, cfg.jnp_dtype,
                           cfg.attn_impl, cfg.attn_block_size,
-                          cfg.seq_axis)(x, train)
+                          cfg.seq_axis, cfg.moe_experts,
+                          cfg.moe_capacity_factor)(x, train)
         x = nn.LayerNorm(epsilon=1e-5)(x.astype(jnp.float32))
 
         # LM head tied to wte (GPT-2 weight tying); logits in f32

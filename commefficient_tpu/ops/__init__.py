@@ -1,4 +1,6 @@
-from commefficient_tpu.ops.topk import topk
 from commefficient_tpu.ops.countsketch import CountSketch
+from commefficient_tpu.ops.moe import MoEFFN, moe_ep_specs, shard_params_ep
+from commefficient_tpu.ops.topk import topk
 
-__all__ = ["topk", "CountSketch"]
+__all__ = ["topk", "CountSketch", "MoEFFN", "moe_ep_specs",
+           "shard_params_ep"]

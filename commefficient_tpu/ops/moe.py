@@ -1,0 +1,116 @@
+"""Mixture-of-Experts FFN with expert parallelism (Switch-style).
+
+The reference has no MoE and no expert parallelism (SURVEY.md §2
+parallelism checklist: absent); this completes the framework's
+parallelism set (DP/SP/TP/PP/EP). TPU-first formulation:
+
+* top-1 routing (Switch Transformer) with a capacity limit: tokens are
+  placed into per-expert slots via cumsum-based position assignment, and
+  dispatch/combine are dense one-hot einsums — static shapes, MXU-
+  friendly, no data-dependent gather/scatter.
+* tokens overflowing an expert's capacity are dropped by the layer (their
+  output contribution is zero); the transformer's residual connection
+  carries them through unchanged — standard Switch behavior.
+* the stacked expert weights (E, ...) are the expert-parallel axis: shard
+  them with ``moe_ep_specs`` over an ``expert`` mesh axis and GSPMD
+  partitions the per-expert einsums, inserting the all-to-alls that the
+  reference ecosystem would hand-write.
+* the load-balancing auxiliary loss (mean fraction-routed x mean router
+  prob, scaled by E) is sown as an intermediate
+  (``sow('intermediates', 'moe_aux_loss', ...)``); training loops that
+  enable MoE should add it to the objective (weight ~1e-2) or routing
+  collapses onto one expert.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class MoEFFN(nn.Module):
+    """Drop-in replacement for a transformer MLP: (N..., C) -> (N..., C)."""
+    num_experts: int
+    d_ff: int
+    capacity_factor: float = 1.25
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        orig_shape = x.shape
+        C = orig_shape[-1]
+        xt = x.reshape(-1, C)                              # (N, C)
+        N = xt.shape[0]
+        E = self.num_experts
+        cap = max(1, int(self.capacity_factor * N / E))
+
+        router = nn.Dense(E, dtype=jnp.float32, name="router",
+                          kernel_init=nn.initializers.normal(0.02))
+        logits = router(xt.astype(jnp.float32))            # (N, E)
+        probs = jax.nn.softmax(logits, axis=-1)
+        expert = jnp.argmax(probs, axis=-1)                # (N,)
+        gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+
+        onehot_e = jax.nn.one_hot(expert, E, dtype=jnp.float32)  # (N, E)
+        # position of each token within its expert's slots (0-based)
+        pos = jnp.cumsum(onehot_e, axis=0) * onehot_e - onehot_e  # (N, E)
+        pos = jnp.sum(pos, axis=-1).astype(jnp.int32)      # (N,)
+        keep = pos < cap
+        # (N, E, cap) one-hot dispatch tensor
+        dispatch = (onehot_e[:, :, None] *
+                    jax.nn.one_hot(pos, cap, dtype=jnp.float32)[:, None, :])
+        dispatch = dispatch * keep[:, None, None]
+
+        # distinctive names: moe_ep_specs shards by param name alone, so
+        # the specs work on any tree containing an MoEFFN at any depth
+        w1 = self.param("moe_w1", nn.initializers.normal(0.02),
+                        (E, C, self.d_ff), jnp.float32)
+        b1 = self.param("moe_b1", nn.initializers.zeros, (E, self.d_ff),
+                        jnp.float32)
+        w2 = self.param("moe_w2", nn.initializers.normal(0.02),
+                        (E, self.d_ff, C), jnp.float32)
+        b2 = self.param("moe_b2", nn.initializers.zeros, (E, C),
+                        jnp.float32)
+
+        dt = self.dtype
+        xin = jnp.einsum("nec,nd->ecd", dispatch.astype(dt), xt.astype(dt))
+        h = nn.gelu(jnp.einsum("ecd,edh->ech", xin, w1.astype(dt))
+                    + b1[:, None, :].astype(dt))
+        out_e = (jnp.einsum("ech,ehd->ecd", h, w2.astype(dt))
+                 + b2[:, None, :].astype(dt))
+        combine = dispatch * gate[:, None, None]
+        out = jnp.einsum("nec,ecd->nd", combine.astype(dt), out_e)
+
+        # Switch load-balancing loss: E * sum_e f_e * p_e, where f_e is the
+        # fraction of tokens routed to e and p_e the mean router prob
+        frac = jnp.mean(onehot_e, axis=0)
+        mean_prob = jnp.mean(probs, axis=0)
+        self.sow("intermediates", "moe_aux_loss",
+                 E * jnp.sum(frac * mean_prob))
+
+        return out.astype(x.dtype).reshape(orig_shape)
+
+
+def moe_ep_specs(params, axis: str = "expert"):
+    """PartitionSpec pytree sharding every stacked-expert weight (leading
+    dim == num_experts) on ``axis``; everything else replicated. Apply to
+    a param tree that contains MoEFFN submodules."""
+
+    def spec(path, leaf):
+        names = [getattr(p, "key", str(p)) for p in path]
+        if any(n in ("moe_w1", "moe_b1", "moe_w2", "moe_b2")
+               for n in names):
+            return P(axis) if leaf.ndim >= 1 else P()
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
+def shard_params_ep(params, mesh: Mesh, axis: str = "expert"):
+    """Place params on the mesh with expert weights sharded over ``axis``."""
+    shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), moe_ep_specs(params, axis),
+        is_leaf=lambda x: isinstance(x, P))
+    return jax.device_put(params, shardings)

@@ -24,11 +24,19 @@ This module exposes LM-forward machinery sufficient for training loops
 and tests; the double-heads MC pick is intentionally out of scope (the
 reference's PersonaChat MC task uses short sequences where PP is
 pointless; PP targets deep-trunk LM work).
+
+MoE blocks compose with the pipeline, with one semantic note: MoE
+capacity is applied per dispatch group, and under PP the group is one
+MICROBATCH (mb*T tokens) instead of the whole batch — tokens drop at
+different capacity boundaries than an unpipelined forward. Outputs are
+identical whenever capacity is non-binding (tested); under binding
+capacity this is the same group-dependence every microbatched Switch
+implementation has.
 """
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 
 import flax.linen as nn
 import jax
@@ -63,6 +71,11 @@ def gpt2_pp_lm_apply(mesh, model, params, input_ids, token_type_ids,
         # ring needs a live 'seq' axis inside the pipe; not composed here
         raise ValueError("gpt2_pp_lm_apply supports attn_impl "
                          "'full'/'blockwise', not 'ring'")
+    if cfg.dropout > 0:
+        # dropout rngs are not plumbed through the pipeline; refuse rather
+        # than silently train in eval mode (set dropout=0 to use PP)
+        raise ValueError("gpt2_pp_lm_apply runs dropout-free; configure "
+                         f"dropout=0 (got {cfg.dropout})")
     S = mesh.shape[axis_name]
     L = cfg.n_layer
     if L % S:
@@ -78,15 +91,41 @@ def gpt2_pp_lm_apply(mesh, model, params, input_ids, token_type_ids,
     staged = jax.tree_util.tree_map(
         lambda leaf: leaf.reshape((S, per_stage) + leaf.shape[1:]), stacked)
 
-    # honor the model config: blockwise (flash) attention composes with PP
-    # for long context, and cfg.remat rematerializes each layer on backward
-    block = Block(cfg.n_head, cfg.dropout, cfg.jnp_dtype, cfg.attn_impl,
-                  cfg.attn_block_size, cfg.seq_axis)
+    block_key = (cfg.n_head, cfg.dtype, cfg.attn_impl, cfg.attn_block_size,
+                 cfg.seq_axis, cfg.moe_experts, cfg.moe_capacity_factor,
+                 cfg.remat)
+    pipe = _build_pipe(mesh, axis_name, block_key, S, per_stage,
+                       B, T, n_micro, mb)
+
+    wte = params["wte"]["embedding"]
+    wpe = params["wpe"]["embedding"]
+    x = pipe(staged, input_ids, token_type_ids, (wte, wpe))
+
+    # final LN + tied LM head (replicated, outside the pipe)
+    x = nn.LayerNorm(epsilon=1e-5).apply(
+        {"params": params["LayerNorm_0"]}, x.astype(jnp.float32))
+    return jnp.einsum("btd,vd->btv", x, wte.astype(jnp.float32))
+
+
+@lru_cache(maxsize=32)
+def _build_pipe(mesh, axis_name, block_key, S, per_stage, B, T, n_micro,
+                mb):
+    """Jitted pipeline schedule, cached so repeated calls (a training
+    loop's every step) reuse the compiled program. Cache key = everything
+    the trace depends on; jax.Mesh is hashable."""
+    (n_head, dtype_str, attn_impl, attn_block_size, seq_axis,
+     moe_experts, moe_cap, remat) = block_key
+    dt = jnp.bfloat16 if dtype_str == "bfloat16" else jnp.float32
+    # dropout pinned to 0 (guarded in gpt2_pp_lm_apply); honor the rest of
+    # the block config — blockwise (flash) attention and MoE compose with
+    # PP (note: MoE aux-loss intermediates are discarded inside the pipe)
+    block = Block(n_head, 0.0, dt, attn_impl, attn_block_size, seq_axis,
+                  moe_experts, moe_cap)
 
     def apply_layer(layer_params, h):
         return block.apply({"params": layer_params}, h, False)
 
-    if cfg.remat:
+    if remat:
         apply_layer = jax.checkpoint(apply_layer)
 
     def run_stage(stage_params, x):
@@ -96,11 +135,8 @@ def gpt2_pp_lm_apply(mesh, model, params, input_ids, token_type_ids,
         h, _ = jax.lax.scan(body, x, stage_params)
         return h
 
-    staged_spec = jax.tree_util.tree_map(
-        lambda _: P(axis_name), staged)
-
     @partial(shard_map, mesh=mesh,
-             in_specs=(staged_spec, P(), P(), P()),
+             in_specs=(P(axis_name), P(), P(), P()),
              out_specs=P(), check_vma=False)
     def pipe(stage_params, ids, types, pos_embed_inputs):
         my = jax.lax.axis_index(axis_name)
@@ -145,13 +181,4 @@ def gpt2_pp_lm_apply(mesh, model, params, input_ids, token_type_ids,
             jnp.where(my == S - 1, outs, 0.0), axis_name)
         return outs.reshape(B, T, C)
 
-    wte = params["wte"]["embedding"]
-    wpe = params["wpe"]["embedding"]
-    # jit: required for remat (closed_call) under shard_map, and fuses the
-    # whole pipeline schedule into one XLA program
-    x = jax.jit(pipe)(staged, input_ids, token_type_ids, (wte, wpe))
-
-    # final LN + tied LM head (replicated, outside the pipe)
-    x = nn.LayerNorm(epsilon=1e-5).apply(
-        {"params": params["LayerNorm_0"]}, x.astype(jnp.float32))
-    return jnp.einsum("btd,vd->btv", x, wte.astype(jnp.float32))
+    return jax.jit(pipe)
