@@ -58,24 +58,30 @@ def stack_block_params(params, n_layer: int):
 
 
 def gpt2_pp_lm_apply(mesh, model, params, input_ids, token_type_ids,
-                     n_micro: int, *, axis_name: str = "stage"):
+                     n_micro: int, *, axis_name: str = "stage",
+                     train: bool = False):
     """LM logits via a GPipe pipeline over ``axis_name``.
 
     ``input_ids``/``token_type_ids`` are (B, T) with B divisible by
     ``n_micro``; blocks split into ``mesh.shape[axis_name]`` contiguous
     stages. Returns (B, T, vocab) float32 logits, replicated. Matches the
     plain forward to float tolerance (tests/test_attention.py).
+
+    The pipeline always runs dropout-free (rngs aren't plumbed through the
+    schedule); that is exactly eval semantics, so inference works with any
+    config. Pass ``train=True`` when taking gradients through this
+    function — it raises if cfg.dropout > 0 rather than silently training
+    without the configured regularization.
     """
     cfg: GPT2Config = model.config
     if cfg.attn_impl == "ring":
         # ring needs a live 'seq' axis inside the pipe; not composed here
         raise ValueError("gpt2_pp_lm_apply supports attn_impl "
                          "'full'/'blockwise', not 'ring'")
-    if cfg.dropout > 0:
-        # dropout rngs are not plumbed through the pipeline; refuse rather
-        # than silently train in eval mode (set dropout=0 to use PP)
-        raise ValueError("gpt2_pp_lm_apply runs dropout-free; configure "
-                         f"dropout=0 (got {cfg.dropout})")
+    if train and cfg.dropout > 0:
+        raise ValueError("the pipeline runs dropout-free; training with "
+                         f"dropout={cfg.dropout} would silently drop the "
+                         "configured regularization (set dropout=0)")
     S = mesh.shape[axis_name]
     L = cfg.n_layer
     if L % S:
@@ -91,9 +97,9 @@ def gpt2_pp_lm_apply(mesh, model, params, input_ids, token_type_ids,
     staged = jax.tree_util.tree_map(
         lambda leaf: leaf.reshape((S, per_stage) + leaf.shape[1:]), stacked)
 
-    block_key = (cfg.n_head, cfg.dtype, cfg.attn_impl, cfg.attn_block_size,
-                 cfg.seq_axis, cfg.moe_experts, cfg.moe_capacity_factor,
-                 cfg.remat)
+    block_key = (cfg.n_head, cfg.jnp_dtype, cfg.attn_impl,
+                 cfg.attn_block_size, cfg.seq_axis, cfg.moe_experts,
+                 cfg.moe_capacity_factor, cfg.remat)
     pipe = _build_pipe(mesh, axis_name, block_key, S, per_stage,
                        B, T, n_micro, mb)
 
@@ -113,12 +119,11 @@ def _build_pipe(mesh, axis_name, block_key, S, per_stage, B, T, n_micro,
     """Jitted pipeline schedule, cached so repeated calls (a training
     loop's every step) reuse the compiled program. Cache key = everything
     the trace depends on; jax.Mesh is hashable."""
-    (n_head, dtype_str, attn_impl, attn_block_size, seq_axis,
+    (n_head, dt, attn_impl, attn_block_size, seq_axis,
      moe_experts, moe_cap, remat) = block_key
-    dt = jnp.bfloat16 if dtype_str == "bfloat16" else jnp.float32
-    # dropout pinned to 0 (guarded in gpt2_pp_lm_apply); honor the rest of
-    # the block config — blockwise (flash) attention and MoE compose with
-    # PP (note: MoE aux-loss intermediates are discarded inside the pipe)
+    # dropout pinned to 0 (see gpt2_pp_lm_apply docstring); honor the rest
+    # of the block config — blockwise (flash) attention and MoE compose
+    # with PP (note: MoE aux-loss intermediates are discarded in the pipe)
     block = Block(n_head, 0.0, dt, attn_impl, attn_block_size, seq_axis,
                   moe_experts, moe_cap)
 

@@ -150,7 +150,9 @@ def train(args, max_rounds=None, log=True):
                 losses.append(o["loss"])
                 return not math.isfinite(o["loss"])
 
-            for ids, cols, mask in batcher.epoch():
+            # next round's batch transfers while this one computes
+            from commefficient_tpu.data.prefetch import device_prefetch
+            for ids, cols, mask in device_prefetch(batcher.epoch()):
                 raw = learner.train_round_async(ids, cols, mask,
                                                 epoch_frac=total_rounds)
                 total_rounds += 1

@@ -288,3 +288,17 @@ def test_persona_raw_json_ingest(tmp_path):
                      num_clients=None, seed=0, max_seq_len=128)
     vids, *_ = val.get_val_batch(np.asarray([0]))
     assert vids.shape == (1, 2, 128) and val.num_val_images == 2
+
+
+def test_device_prefetch_preserves_order_and_values():
+    import jax
+    from commefficient_tpu.data.prefetch import device_prefetch
+    items = [(np.full((2,), i), (np.full((3,), i * 10),)) for i in range(5)]
+    out = list(device_prefetch(iter(items), size=2))
+    assert len(out) == 5
+    for i, (a, (b,)) in enumerate(out):
+        assert isinstance(a, jax.Array)
+        np.testing.assert_array_equal(np.asarray(a), np.full((2,), i))
+        np.testing.assert_array_equal(np.asarray(b), np.full((3,), i * 10))
+    # size larger than the stream
+    assert len(list(device_prefetch(iter(items), size=99))) == 5
