@@ -17,5 +17,3 @@ fed_datasets = {
 __all__ = ["FedDataset", "FedCIFAR10", "FedCIFAR100", "FedEMNIST",
            "FedImageNet", "SyntheticCV", "FedSampler", "FedBatcher",
            "val_batches", "fed_datasets"]
-from commefficient_tpu.data.prefetch import device_prefetch  # noqa: E402
-__all__.append("device_prefetch")

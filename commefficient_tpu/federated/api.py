@@ -61,6 +61,12 @@ class FedLearner:
         self.total_upload_bytes = 0.0
 
     @property
+    def batch_shardings(self):
+        """Per-round batch shardings on the mesh (None off-mesh) — for
+        sharding-aware prefetch (data.prefetch.device_prefetch)."""
+        return self._batch_sh if self.mesh is not None else None
+
+    @property
     def params(self):
         """Current global model as a pytree (for checkpoint/eval exports)."""
         return self.unflatten(self.state.weights)

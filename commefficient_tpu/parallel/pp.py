@@ -59,7 +59,7 @@ def stack_block_params(params, n_layer: int):
 
 def gpt2_pp_lm_apply(mesh, model, params, input_ids, token_type_ids,
                      n_micro: int, *, axis_name: str = "stage",
-                     train: bool = False):
+                     train: bool = True):
     """LM logits via a GPipe pipeline over ``axis_name``.
 
     ``input_ids``/``token_type_ids`` are (B, T) with B divisible by
@@ -67,11 +67,12 @@ def gpt2_pp_lm_apply(mesh, model, params, input_ids, token_type_ids,
     stages. Returns (B, T, vocab) float32 logits, replicated. Matches the
     plain forward to float tolerance (tests/test_attention.py).
 
-    The pipeline always runs dropout-free (rngs aren't plumbed through the
-    schedule); that is exactly eval semantics, so inference works with any
-    config. Pass ``train=True`` when taking gradients through this
-    function — it raises if cfg.dropout > 0 rather than silently training
-    without the configured regularization.
+    The pipeline always runs dropout-free (rngs aren't plumbed through
+    the schedule). Under the default ``train=True`` it therefore raises if
+    cfg.dropout > 0 — taking gradients would silently drop the configured
+    regularization, and that cannot be detected from inside. Inference
+    with a dropout-configured model is fine: pass ``train=False``
+    explicitly (dropout-free IS eval semantics).
     """
     cfg: GPT2Config = model.config
     if cfg.attn_impl == "ring":

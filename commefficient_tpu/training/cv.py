@@ -125,7 +125,7 @@ def train(args, mesh=None, max_rounds=None, log=True):
             # next round's batch transfers while this one computes
             # (sharding-aware on a mesh: lands directly on the shards)
             from commefficient_tpu.data.prefetch import device_prefetch
-            batch_sh = learner._batch_sh if mesh is not None else None
+            batch_sh = learner.batch_shardings
             for ids, cols, mask in device_prefetch(batcher.epoch(),
                                                    shardings=batch_sh):
                 frac = total_rounds / max(spe, 1)

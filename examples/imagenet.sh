@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# ImageNet reference configuration (reference imagenet.sh:2-21, with flags
+# that actually exist — the reference script's --mixup/--supervised went
+# stale against its own parser, SURVEY.md §2.20).
+#
+# FixupResNet50, uncompressed mode, iid, 7 clients / 7 sampled per round,
+# virtual momentum 0.9, weight decay 1e-4, batch 64 per client. Extract
+# ImageNet under $DATASET_DIR/{train,val}/<wnid>/*.JPEG first; the data
+# layer preprocesses once into per-client uint8 arrays.
+set -euo pipefail
+
+DATASET_DIR="${DATASET_DIR:-./dataset/imagenet}"
+
+python -m commefficient_tpu.training.cv \
+    --dataset_name ImageNet \
+    --model FixupResNet50 \
+    --mode uncompressed \
+    --iid \
+    --num_clients 7 \
+    --num_workers 7 \
+    --local_batch_size 64 \
+    --valid_batch_size 64 \
+    --virtual_momentum 0.9 \
+    --weight_decay 1e-4 \
+    --num_epochs 24 \
+    --pivot_epoch 5 \
+    --lr_scale 0.4 \
+    --dataset_dir "$DATASET_DIR" \
+    "$@"

@@ -397,3 +397,34 @@ def test_async_pipeline_matches_blocking():
     assert ln_a.total_download_bytes == ln_b.total_download_bytes
     np.testing.assert_array_equal(np.asarray(ln_a.state.weights),
                                   np.asarray(ln_b.state.weights))
+
+
+def test_topk_down_reconstructs_stale_weights():
+    # topk_down (ref fed_worker.py:151-157, 232-247): each client carries
+    # stale weights and reconstructs its forward weights as
+    # stale + topk(ps - stale, k). With k == d the reconstruction is
+    # EXACT, so the trajectory must equal the same run without topk_down.
+    def make(topk_down):
+        cfg = FedConfig(mode="true_topk", error_type="virtual", k=1,
+                        virtual_momentum=0.0, local_momentum=0,
+                        weight_decay=0, num_workers=1, num_clients=3,
+                        lr_scale=0.02, do_topk_down=topk_down)
+        return toy_learner(cfg)
+
+    ids, batch, mask = one_worker_batch()
+    ln_plain, ln_down = make(False), make(True)
+    assert ln_down.state.clients.weights is not None  # per-client state
+    assert ln_plain.state.clients.weights is None
+    for _ in range(3):
+        w_before = np.asarray(ln_down.state.weights).copy()
+        a = ln_plain.train_round(ids, batch, mask)
+        b = ln_down.train_round(ids, batch, mask)
+        assert a["loss"] == b["loss"]
+    np.testing.assert_array_equal(np.asarray(ln_plain.state.weights),
+                                  np.asarray(ln_down.state.weights))
+    # the participating client's stale row holds its last FORWARD weights
+    # (exact reconstruction at k=d = the round-start ps weights); a
+    # never-sampled client still holds the init weights
+    w0 = np.asarray(ln_down.state.clients.weights)
+    np.testing.assert_array_equal(w0[0], w_before)
+    assert not np.allclose(w0[2], w_before)
