@@ -122,8 +122,11 @@ def bench_cifar_sketch():
     return 1.0 / round_time, breakdown
 
 
-def bench_gpt2_tokens():
+def _gpt2_fed_setup(**cfg_kw):
+    """Shared gpt2-small federated-bench setup: model, learner, and a
+    device-resident synthetic PersonaChat batch (W=4, B=4, C=2, T=256)."""
     import jax
+    import jax.numpy as jnp
 
     from commefficient_tpu.config import FedConfig
     from commefficient_tpu.federated.api import FedLearner
@@ -136,9 +139,8 @@ def bench_gpt2_tokens():
     gcfg.dropout = 0.1
     gcfg.dtype = "bfloat16"  # MXU-native compute; params stay f32
     model = GPT2DoubleHeads(gcfg)
-    cfg = FedConfig(mode="uncompressed", error_type="none",
-                    virtual_momentum=0.9, local_momentum=0, weight_decay=0,
-                    num_workers=W, num_clients=16, lr_scale=4e-2)
+    cfg = FedConfig(virtual_momentum=0.9, local_momentum=0, weight_decay=0,
+                    num_workers=W, num_clients=16, lr_scale=4e-2, **cfg_kw)
 
     rng = np.random.RandomState(0)
     ids = rng.randint(0, 50000, (W, B, C, T)).astype(np.int32)
@@ -146,8 +148,9 @@ def bench_gpt2_tokens():
     mc = np.full((W, B, C), T - 1, np.int32)
     labels = np.where(rng.rand(W, B, C, T) < 0.3, ids, -1).astype(np.int32)
     mcl = np.ones((W, B), np.int32)
-    mask = np.ones((W, B), np.float32)
-    batch = (ids, mc, labels, mcl, types)
+    batch = tuple(jax.device_put(jnp.asarray(t))
+                  for t in (ids, mc, labels, mcl, types))
+    mask = jax.device_put(jnp.ones((W, B), jnp.float32))
 
     class _Wrap:
         def init(self, rng_, sample_in, train):
@@ -158,31 +161,46 @@ def bench_gpt2_tokens():
 
     learner = FedLearner(
         _Wrap(), cfg, make_gpt2_train_loss(model), None,
-        jax.random.PRNGKey(0), (ids[0][:1], types[0][:1], mc[0][:1]))
-
-    import jax.numpy as jnp
-    batch_d = tuple(jax.device_put(jnp.asarray(t)) for t in batch)
-    mask_d = jax.device_put(jnp.asarray(mask, jnp.float32))
+        jax.random.PRNGKey(0), (batch[0][0][:1], batch[4][0][:1],
+                                batch[1][0][:1]))
 
     def one_round(r):
         w_ids = (np.arange(W) + r * W) % cfg.num_clients
-        return learner.train_round_async(w_ids, batch_d, mask_d)
+        return learner.train_round_async(w_ids, batch, mask)
 
+    return learner, one_round, W * B * C * T
+
+
+def _timed_windows(learner, one_round, n_windows=3, n_rounds=4):
+    """Compile + warm, then median steady-state seconds/round over
+    ``n_windows`` back-to-back async windows (one sync per window)."""
     learner.finalize_round_metrics(one_round(0))  # compile
     learner.finalize_round_metrics(one_round(1))  # warm
-    # steady-state throughput, median of 3 windows (contention robustness)
-    N = 4
     window_times = []
-    for w in range(3):
+    for w in range(n_windows):
         t0 = time.perf_counter()
         raw = None
-        for r in range(N):
-            raw = one_round(2 + w * N + r)
+        for r in range(n_rounds):
+            raw = one_round(2 + w * n_rounds + r)
         learner.finalize_round_metrics(raw)
-        window_times.append((time.perf_counter() - t0) / N)
-    round_time = float(np.median(window_times))
-    tokens_per_round = W * B * C * T
-    return tokens_per_round / round_time
+        window_times.append((time.perf_counter() - t0) / n_rounds)
+    return float(np.median(window_times))
+
+
+def bench_gpt2_tokens():
+    learner, one_round, tokens_per_round = _gpt2_fed_setup(
+        mode="uncompressed", error_type="none")
+    return tokens_per_round / _timed_windows(learner, one_round)
+
+
+def bench_gpt2_sketch_rounds():
+    """FetchSGD on gpt2-small itself (d~124M) — the paper's NLP headline:
+    5x500k sketch compresses the 474MB gradient to 9.5MB per client per
+    round. One full federated sketch round on PersonaChat shapes."""
+    learner, one_round, _ = _gpt2_fed_setup(
+        mode="sketch", error_type="virtual", k=50_000, num_rows=5,
+        num_cols=500_000)
+    return 1.0 / _timed_windows(learner, one_round, n_rounds=3)
 
 
 def bench_longcontext_tokens():
@@ -238,6 +256,7 @@ def main():
     with profile_ctx(args.profile):
         rounds_per_sec, breakdown = bench_cifar_sketch()
         gpt2_tokens = bench_gpt2_tokens()
+        gpt2_sketch = bench_gpt2_sketch_rounds()
         longctx_tokens = bench_longcontext_tokens()
 
     print(json.dumps({
@@ -249,6 +268,10 @@ def main():
             "metric": "gpt2_personachat_tokens_per_sec_chip",
             "value": round(gpt2_tokens, 1),
             "unit": "tokens/sec",
+        }, {
+            "metric": "gpt2_fetchsgd_sketch_rounds_per_sec",
+            "value": round(gpt2_sketch, 4),
+            "unit": "rounds/sec",
         }, {
             "metric": "gpt2_longcontext_4k_blockwise_tokens_per_sec_chip",
             "value": round(longctx_tokens, 1),
