@@ -154,11 +154,22 @@ class FedImageNet(FedDataset):
             cache[fn] = cache.pop(fn)  # refresh LRU position
         return cache[fn]
 
+    @staticmethod
+    def _gather(arr, idxs: np.ndarray) -> np.ndarray:
+        """Rows ``arr[idxs]``: read in sorted order (mmap locality), restore
+        request order; threaded native memcpy when available (the copy out
+        of the page cache is the val/train feed's hot loop)."""
+        from commefficient_tpu import native
+        order = np.sort(np.asarray(idxs))
+        inv = np.argsort(np.argsort(idxs))
+        if native.lib() is not None and arr.flags["C_CONTIGUOUS"]:
+            return native.gather_rows(arr, order)[inv]
+        return np.asarray(arr[order])[inv]
+
     def _get_train_batch(self, client_id: int, idxs: np.ndarray):
         arr = self._mmap(self._client_fn(client_id))
-        # read rows in sorted order (mmap locality), restore request order;
         # sampler indices are unique within a client
-        return (np.asarray(arr[np.sort(idxs)])[np.argsort(np.argsort(idxs))],
+        return (self._gather(arr, idxs),
                 np.full(len(idxs), client_id, np.int32))
 
     def _get_val_batch(self, idxs: np.ndarray):
@@ -166,6 +177,4 @@ class FedImageNet(FedDataset):
         if self._val_targets is None:
             self._val_targets = np.load(
                 os.path.join(self.dataset_dir, "val_targets.npy"))
-        order = np.sort(np.asarray(idxs))
-        return (np.asarray(imgs[order])[np.argsort(np.argsort(idxs))],
-                self._val_targets[idxs])
+        return self._gather(imgs, idxs), self._val_targets[idxs]

@@ -1,14 +1,26 @@
-"""Host-side numpy augmentation pipelines (reference
+"""Host-side augmentation pipelines (reference
 data_utils/transforms.py:3-75, torchvision-based there).
 
 Images flow as NHWC float32. Each transform is
 ``fn(cols, rng) -> cols`` over the batch's column list (first column is the
 image batch), so pipelines compose with plain function composition.
+
+Two implementations per train pipeline:
+
+* pure numpy (always available; the reference semantics, documented here)
+* a fused native path through ``commefficient_tpu.native`` (C++ threaded
+  crop+resize+flip+normalize kernels) used automatically when the native
+  library builds. Both paths draw the SAME random sequence from the same
+  ``RandomState`` — randomness is sampled in Python and only deterministic
+  pixel math moves to C++ — so they produce identical augmentations
+  (cross-checked in tests/test_native.py).
 """
 
 from __future__ import annotations
 
 import numpy as np
+
+from commefficient_tpu import native
 
 CIFAR10_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
 CIFAR10_STD = np.array([0.2471, 0.2435, 0.2616], np.float32)
@@ -82,31 +94,38 @@ def _bilinear_resize(img: np.ndarray, out_h: int, out_w: int) -> np.ndarray:
     return top * (1 - wy) + bot * wy
 
 
+def rrc_crop_params(h, w, rng, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3)):
+    """Sample one RandomResizedCrop window (torchvision semantics, ref
+    transforms.py:68): 10 area/aspect attempts, center fallback. Shared by
+    the numpy and native pipelines so both consume the same rng sequence."""
+    log_ratio = (np.log(ratio[0]), np.log(ratio[1]))
+    area = h * w
+    for _ in range(10):
+        target_area = area * rng.uniform(scale[0], scale[1])
+        aspect = np.exp(rng.uniform(log_ratio[0], log_ratio[1]))
+        cw = int(round(np.sqrt(target_area * aspect)))
+        ch = int(round(np.sqrt(target_area / aspect)))
+        if 0 < cw <= w and 0 < ch <= h:
+            top = rng.randint(0, h - ch + 1)
+            left = rng.randint(0, w - cw + 1)
+            return top, left, ch, cw
+    # fallback: largest center crop within the ratio bounds
+    in_ratio = w / h
+    if in_ratio < ratio[0]:
+        cw, ch = w, int(round(w / ratio[0]))
+    elif in_ratio > ratio[1]:
+        ch, cw = h, int(round(h * ratio[1]))
+    else:
+        cw, ch = w, h
+    return (h - ch) // 2, (w - cw) // 2, ch, cw
+
+
 def random_resized_crop(size: int, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3)):
     """torchvision RandomResizedCrop semantics (ref transforms.py:68): sample
     an area/aspect crop (10 attempts, center fallback), resize to ``size``."""
-    log_ratio = (np.log(ratio[0]), np.log(ratio[1]))
 
     def crop_params(h, w, rng):
-        area = h * w
-        for _ in range(10):
-            target_area = area * rng.uniform(scale[0], scale[1])
-            aspect = np.exp(rng.uniform(log_ratio[0], log_ratio[1]))
-            cw = int(round(np.sqrt(target_area * aspect)))
-            ch = int(round(np.sqrt(target_area / aspect)))
-            if 0 < cw <= w and 0 < ch <= h:
-                top = rng.randint(0, h - ch + 1)
-                left = rng.randint(0, w - cw + 1)
-                return top, left, ch, cw
-        # fallback: largest center crop within the ratio bounds
-        in_ratio = w / h
-        if in_ratio < ratio[0]:
-            cw, ch = w, int(round(w / ratio[0]))
-        elif in_ratio > ratio[1]:
-            ch, cw = h, int(round(h * ratio[1]))
-        else:
-            cw, ch = w, h
-        return (h - ch) // 2, (w - cw) // 2, ch, cw
+        return rrc_crop_params(h, w, rng, scale, ratio)
 
     def fn(cols, rng):
         img = cols[0]
@@ -151,23 +170,81 @@ def compose(*fns):
     return fn
 
 
-cifar10_train_transforms = compose(
-    normalize(CIFAR10_MEAN, CIFAR10_STD),
-    random_crop(32, 4, "reflect"), random_hflip())
+def fused_rrc_train(mean, std, size: int, hflip_p: float = 0.5,
+                    scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3)):
+    """RandomResizedCrop + hflip + normalize as ONE native pass when the
+    C++ library is available (crop windows and flips still sampled here, in
+    the exact order the numpy stages would), numpy stages otherwise."""
+    numpy_fn = compose(random_resized_crop(size, scale, ratio),
+                       random_hflip(hflip_p), normalize(mean, std))
+    # affine on raw uint8: v/255 -> (v - mean)/std  ==  v*kscale + kbias
+    kscale = (1.0 / (255.0 * std)).astype(np.float32)
+    kbias = (-mean / std).astype(np.float32)
+
+    def fn(cols, rng):
+        img = cols[0]
+        if (native.lib() is None or img.dtype != np.uint8
+                or img.shape[3] != len(kscale)):
+            return numpy_fn(cols, rng)
+        B, h, w = img.shape[:3]
+        params = np.empty((B, 5), np.int32)
+        for i in range(B):
+            params[i, :4] = rrc_crop_params(h, w, rng, scale, ratio)
+        params[:, 4] = rng.rand(B) < hflip_p
+        cols[0] = native.rrc_batch(img, params, size, kscale, kbias)
+        return cols
+    return fn
+
+
+def fused_pad_crop_train(mean, std, size: int, padding: int,
+                         mode: str = "reflect", fill: float = 0.0,
+                         hflip_p: float = 0.5):
+    """normalize + random_crop + hflip with the geometric part as one
+    native pass (bit-identical to the numpy stages — it is pure copies)."""
+    aug = ([random_crop(size, padding, mode, fill)] +
+           ([random_hflip(hflip_p)] if hflip_p > 0 else []))
+    numpy_fn = compose(normalize(mean, std), *aug)
+    norm_fn = normalize(mean, std)
+    # NOTE: normalize runs first (matching the numpy pipeline and reference
+    # transforms.py:47), so a constant ``fill`` lands in the output
+    # verbatim, post-normalization — e.g. EMNIST's fill=1.0 means "1.0 in
+    # normalized space", not raw white
+
+    def fn(cols, rng):
+        img = cols[0]
+        # the kernel (like the numpy stage, which writes into
+        # empty_like(img)) only supports size == H == W; anything else
+        # goes to the numpy path, which fails loudly on the mismatch
+        if (native.lib() is None or img.shape[1] != size
+                or img.shape[2] != size):
+            return numpy_fn(cols, rng)
+        cols = norm_fn(cols, rng)
+        img = cols[0]
+        B = img.shape[0]
+        params = np.empty((B, 3), np.int32)
+        for i in range(B):
+            params[i, 0] = rng.randint(0, 2 * padding + 1)
+            params[i, 1] = rng.randint(0, 2 * padding + 1)
+        params[:, 2] = (rng.rand(B) < hflip_p) if hflip_p > 0 else 0
+        cols[0] = native.pad_crop_batch(img, params, padding,
+                                        mode == "reflect", fill)
+        return cols
+    return fn
+
+
+cifar10_train_transforms = fused_pad_crop_train(
+    CIFAR10_MEAN, CIFAR10_STD, 32, 4, "reflect")
 cifar10_test_transforms = normalize(CIFAR10_MEAN, CIFAR10_STD)
-cifar100_train_transforms = compose(
-    normalize(CIFAR100_MEAN, CIFAR100_STD),
-    random_crop(32, 4, "reflect"), random_hflip())
+cifar100_train_transforms = fused_pad_crop_train(
+    CIFAR100_MEAN, CIFAR100_STD, 32, 4, "reflect")
 cifar100_test_transforms = normalize(CIFAR100_MEAN, CIFAR100_STD)
-femnist_train_transforms = compose(
-    normalize(FEMNIST_MEAN, FEMNIST_STD),
-    random_crop(28, 2, "constant", fill=1.0))
+femnist_train_transforms = fused_pad_crop_train(
+    FEMNIST_MEAN, FEMNIST_STD, 28, 2, "constant", fill=1.0, hflip_p=0.0)
 femnist_test_transforms = normalize(FEMNIST_MEAN, FEMNIST_STD)
 # stored uint8 @ 256 -> RandomResizedCrop(224)+flip (train) /
 # resize(256)+center-crop(224) (val) -> normalize (ref transforms.py:62-75)
-imagenet_train_transforms = compose(
-    random_resized_crop(224), random_hflip(),
-    normalize(IMAGENET_MEAN, IMAGENET_STD))
+imagenet_train_transforms = fused_rrc_train(
+    IMAGENET_MEAN, IMAGENET_STD, 224)
 imagenet_val_transforms = compose(
     resize_center_crop(224, resize_to=256),
     normalize(IMAGENET_MEAN, IMAGENET_STD))

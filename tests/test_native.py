@@ -1,0 +1,144 @@
+"""Native (C++) data-plane tests: the fedio kernels must reproduce the
+pure-numpy reference pipelines exactly where the math is exact (pure
+copies) and to float rounding where it is not (bilinear interpolation).
+
+The build is exercised implicitly: ``native.lib()`` compiles fedio.cpp on
+first use. If no compiler exists in the environment the whole module
+skips — the numpy fallback is what every other test file runs on.
+"""
+
+import numpy as np
+import pytest
+
+from commefficient_tpu import native
+from commefficient_tpu.data import transforms as T
+
+pytestmark = pytest.mark.skipif(native.lib() is None,
+                                reason="native fedio library unavailable")
+
+
+def test_gather_rows_matches_fancy_indexing():
+    rng = np.random.RandomState(0)
+    src = rng.randint(0, 255, (64, 17, 3), np.uint8)
+    idx = rng.randint(0, 64, 40)
+    np.testing.assert_array_equal(native.gather_rows(src, idx), src[idx])
+    fsrc = rng.randn(32, 5).astype(np.float32)
+    np.testing.assert_array_equal(native.gather_rows(fsrc, idx % 32),
+                                  fsrc[idx % 32])
+
+
+def test_gather_rows_guards():
+    """The C side is a raw memcpy: empty gathers must work and bad indices
+    must raise (numpy semantics), never read out-of-buffer memory."""
+    src = np.arange(12, dtype=np.float32).reshape(4, 3)
+    out = native.gather_rows(src, np.array([], np.int64))
+    assert out.shape == (0, 3)
+    for bad in ([4], [-1]):
+        with pytest.raises(IndexError):
+            native.gather_rows(src, np.array(bad, np.int64))
+
+
+def test_rrc_batch_matches_numpy_pipeline():
+    rng_np = np.random.RandomState(7)
+    rng_nat = np.random.RandomState(7)
+    imgs = np.random.RandomState(1).randint(0, 256, (6, 64, 48, 3),
+                                            np.uint8)
+    mean, std = T.IMAGENET_MEAN, T.IMAGENET_STD
+    numpy_fn = T.compose(T.random_resized_crop(32), T.random_hflip(),
+                         T.normalize(mean, std))
+    out_np = numpy_fn([imgs], rng_np)[0]
+
+    fused = T.fused_rrc_train(mean, std, 32)
+    out_nat = fused([imgs], rng_nat)[0]
+    assert out_nat.shape == out_np.shape == (6, 32, 32, 3)
+    # same crops/flips (same rng draws); bilinear differs only in float
+    # evaluation order
+    np.testing.assert_allclose(out_nat, out_np, atol=2e-4)
+
+
+def test_rrc_consumes_same_rng_as_numpy():
+    """After the fused pass, the rng must sit at the same position the
+    numpy stages leave it (mid-epoch switching must not fork the stream)."""
+    imgs = np.random.RandomState(1).randint(0, 256, (4, 40, 40, 3),
+                                            np.uint8)
+    rng_a, rng_b = np.random.RandomState(3), np.random.RandomState(3)
+    T.compose(T.random_resized_crop(16), T.random_hflip(),
+              T.normalize(T.IMAGENET_MEAN, T.IMAGENET_STD))([imgs], rng_a)
+    T.fused_rrc_train(T.IMAGENET_MEAN, T.IMAGENET_STD, 16)([imgs], rng_b)
+    assert rng_a.randint(1 << 30) == rng_b.randint(1 << 30)
+
+
+@pytest.mark.parametrize("mode,fill,hflip_p", [("reflect", 0.0, 0.5),
+                                               ("constant", 1.0, 0.0)])
+def test_pad_crop_bit_identical_to_numpy(mode, fill, hflip_p):
+    """The geometric kernels are pure copies — bit-equality, not allclose.
+    Covers the CIFAR (reflect+flip) and EMNIST (constant-fill white, no
+    flip) configurations."""
+    mean = np.array([0.5], np.float32)
+    std = np.array([0.25], np.float32)
+    imgs = np.random.RandomState(2).randint(0, 256, (5, 28, 28, 1),
+                                            np.uint8)
+    aug = [T.random_crop(28, 2, mode, fill)]
+    if hflip_p > 0:
+        aug.append(T.random_hflip(hflip_p))
+    numpy_fn = T.compose(T.normalize(mean, std), *aug)
+    fused = T.fused_pad_crop_train(mean, std, 28, 2, mode, fill, hflip_p)
+    rng_a, rng_b = np.random.RandomState(9), np.random.RandomState(9)
+    out_np = numpy_fn([imgs], rng_a)[0]
+    out_nat = fused([imgs], rng_b)[0]
+    np.testing.assert_array_equal(out_nat, out_np)
+
+
+def test_thread_pool_parallel_and_concurrent_callers():
+    """Force the multi-thread pool path (this CI box may report 1 CPU) and
+    hammer it from several Python threads at once: results must match the
+    serial path and the pool must not deadlock or corrupt a job."""
+    import ctypes
+    import threading
+
+    h = native.lib()
+    rng = np.random.RandomState(0)
+    src = rng.randint(0, 255, (512, 33), np.uint8)
+    row_bytes = src.shape[1]
+
+    def gather(idx, nthreads):
+        out = np.empty((len(idx), row_bytes), np.uint8)
+        h.fedio_gather_rows(src, np.ascontiguousarray(idx, np.int64),
+                            len(idx), row_bytes, out,
+                            ctypes.c_int(nthreads))
+        return out
+
+    idx0 = rng.randint(0, 512, 300)
+    np.testing.assert_array_equal(gather(idx0, 4), src[idx0])
+
+    errs = []
+
+    def worker(seed):
+        r = np.random.RandomState(seed)
+        for _ in range(50):
+            idx = r.randint(0, 512, 257)
+            if not np.array_equal(gather(idx, 4), src[idx]):
+                errs.append(seed)
+                return
+
+    threads = [threading.Thread(target=worker, args=(s,)) for s in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errs == []
+
+
+def test_cifar_train_pipeline_is_fused_and_matches():
+    """The shipped cifar10_train_transforms (fused) vs an explicitly
+    composed numpy pipeline on CIFAR-shaped data."""
+    imgs = np.random.RandomState(4).randint(0, 256, (8, 32, 32, 3),
+                                            np.uint8)
+    labels = np.arange(8)
+    numpy_fn = T.compose(T.normalize(T.CIFAR10_MEAN, T.CIFAR10_STD),
+                         T.random_crop(32, 4, "reflect"), T.random_hflip())
+    rng_a, rng_b = np.random.RandomState(11), np.random.RandomState(11)
+    out_np = numpy_fn([imgs, labels], rng_a)
+    out_nat = T.cifar10_train_transforms([imgs, labels], rng_b)
+    np.testing.assert_array_equal(out_nat[0], out_np[0])
+    np.testing.assert_array_equal(out_nat[1], labels)
