@@ -48,7 +48,12 @@ def full_attention(q, k, v, *, causal: bool = True,
     """Plain O(T^2)-memory attention; the correctness reference."""
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D)
+    # f32 scores via MXU accumulation (NOT a bf16 einsum + cast: XLA may
+    # fold the cast into downstream reductions at bf16, corrupting the
+    # _NEG sentinel enough that the online-softmax exps blow up — observed
+    # as NaN grads on TPU)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) / np.sqrt(D)
     if causal:
         qp = jnp.arange(Tq)[:, None]
         kp = jnp.arange(Tk)[None, :]
@@ -70,16 +75,24 @@ def _fold_block(acc, q, kb, vb, q_pos, k_pos, kv_mask_b, causal):
     acc = (m (B,H,Tq), l (B,H,Tq), o (B,Tq,H,D)); f32 statistics."""
     m, l, o = acc
     D = q.shape[-1]
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, kb).astype(jnp.float32) / np.sqrt(D)
+    # preferred_element_type, not .astype: see full_attention's comment
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, kb,
+                   preferred_element_type=jnp.float32) / np.sqrt(D)
     if causal:
         s = jnp.where((k_pos[None, :] <= q_pos[:, None])[None, None], s, _NEG)
     if kv_mask_b is not None:
         s = jnp.where(kv_mask_b[:, None, None, :], s, _NEG)
     m_new = jnp.maximum(m, jnp.max(s, axis=-1))
     # explicit zero for masked entries: when every score so far is _NEG,
-    # exp(s - m_new) would be exp(0) = 1 and re-enable them
-    p = jnp.where(s <= _NEG / 2, 0.0, jnp.exp(s - m_new[..., None]))
-    corr = jnp.exp(m - m_new)
+    # exp(s - m_new) would be exp(0) = 1 and re-enable them.
+    # The exponents are clamped at 0: mathematically s <= m_new and
+    # m <= m_new always, but XLA fusion may recompute the two sides of the
+    # subtraction along different (mixed-precision) paths, and at sentinel
+    # magnitude the rounding slop can reach exp-overflow — inf * 0 = NaN in
+    # the VJP (observed on TPU bf16 with >1 kv block; the clamp is free)
+    p = jnp.where(s <= _NEG / 2, 0.0,
+                  jnp.exp(jnp.minimum(s - m_new[..., None], 0.0)))
+    corr = jnp.exp(jnp.minimum(m - m_new, 0.0))
     l_new = l * corr + jnp.sum(p, axis=-1)
     pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), vb)
     o_new = o * corr.transpose(0, 2, 1)[..., None] + pv.astype(jnp.float32)
@@ -94,8 +107,27 @@ def _finish(m, l, o, dtype):
 
 def blockwise_attention(q, k, v, *, causal: bool = True,
                         kv_mask: Optional[jax.Array] = None,
-                        block_size: int = 512) -> jax.Array:
-    """Flash-style attention: scan over key/value blocks, O(T*block) memory."""
+                        block_size: int = 512,
+                        use_kernel: Optional[bool] = None) -> jax.Array:
+    """Flash-style attention: O(T*block) memory on any backend.
+
+    On TPU, calls the fused Pallas kernel (ops/flash_attention.py — 3.1x
+    the lax.scan formulation for fwd+bwd at T=4096) whenever the call is
+    kernel-supported (causal self-attention, no kv_mask); otherwise scans
+    over key/value blocks with the same online softmax. ``use_kernel``
+    forces the choice (None = auto); ``block_size`` applies to the scan
+    path only — the kernel picks its own swept block sizes."""
+    from commefficient_tpu.ops import flash_attention as _fa
+    if use_kernel is None:
+        # allowlist: the tunneled chip's backend can report 'tpu' or 'axon'
+        use_kernel = (_fa.supported(q, k, v, causal, kv_mask)
+                      and jax.default_backend() in ("tpu", "axon"))
+    if use_kernel:
+        if not _fa.supported(q, k, v, causal, kv_mask):
+            raise ValueError(
+                "use_kernel=True but the call is not kernel-supported "
+                "(needs causal self-attention without kv_mask)")
+        return _fa.flash_attention(q, k, v, causal=causal)
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     bs = min(block_size, Tk)
