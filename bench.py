@@ -196,10 +196,13 @@ def bench_gpt2_tokens():
 def bench_gpt2_sketch_rounds():
     """FetchSGD on gpt2-small itself (d~124M) — the paper's NLP headline:
     5x500k sketch compresses the 474MB gradient to 9.5MB per client per
-    round. One full federated sketch round on PersonaChat shapes."""
+    round. One full federated sketch round on PersonaChat shapes.
+    Uses topk_approx_recall=0.95 (the TPU-native approx_max_k selector,
+    5.4x faster than the exact sort at this d/k; missed coordinates ride
+    the error-feedback accumulator — config.py/ops/topk.py docstrings)."""
     learner, one_round, _ = _gpt2_fed_setup(
         mode="sketch", error_type="virtual", k=50_000, num_rows=5,
-        num_cols=500_000)
+        num_cols=500_000, topk_approx_recall=0.95)
     return 1.0 / _timed_windows(learner, one_round, n_rounds=3)
 
 
@@ -241,8 +244,22 @@ def bench_longcontext_tokens():
                 lp, tgt[..., None], axis=-1))
         return jax.grad(loss_fn)(p)
 
-    t = _time(lambda: step(params)["wte"]["embedding"], n=6)
-    return B * T / t
+    # steady-state throughput, same convention as the federated metrics:
+    # dispatch a window of steps back-to-back, sync once — the per-dispatch
+    # tunnel round-trip (~150ms on the shared chip) otherwise swamps the
+    # ~40ms step
+    _sync(step(params)["wte"]["embedding"])  # compile
+    _sync(step(params)["wte"]["embedding"])  # warm
+    n_windows, n_steps = 3, 5
+    times = []
+    for _ in range(n_windows):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(n_steps):
+            out = step(params)
+        _sync(out["wte"]["embedding"])
+        times.append((time.perf_counter() - t0) / n_steps)
+    return B * T / float(np.median(times))
 
 
 def main():

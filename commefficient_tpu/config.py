@@ -47,6 +47,14 @@ class FedConfig:
     # sketch/unsketch at default sizes); 'global' = classic per-coordinate
     # hashing (csvec-style). See ops/countsketch.py module docstring.
     sketch_scheme: str = "tiled"
+    # 0.0 = exact top-k selection (reference parity). Setting a recall
+    # target in (0, 1] switches every top-k in the pipeline (unsketch,
+    # true_topk, local_topk, topk_down) to jax.lax.approx_max_k — the
+    # TPU-native partial-reduction selector, 5.4x faster at d=124M/k=50k
+    # (0.988 measured recall at target 0.95). Missed coordinates stay in
+    # the error-feedback accumulators, the same mechanism that absorbs
+    # sketch-recovery noise (ops/topk.py module docstring).
+    topk_approx_recall: float = 0.0
 
     # optimization. NOTE: the reference defaults local_momentum to 0.9
     # (utils.py:151) which is invalid with its own default mode='sketch'
@@ -107,6 +115,9 @@ class FedConfig:
                 f"error_type must be one of {ERROR_TYPES}, got {self.error_type!r}")
         if self.dp_mode not in DP_MODES:
             raise ValueError(f"dp_mode must be one of {DP_MODES}")
+        if not 0.0 <= self.topk_approx_recall <= 1.0:
+            raise ValueError("topk_approx_recall must be in [0, 1] "
+                             "(0 = exact top-k)")
         if self.sketch_scheme not in ("tiled", "global"):
             raise ValueError("sketch_scheme must be 'tiled' or 'global', "
                              f"got {self.sketch_scheme!r}")

@@ -116,7 +116,7 @@ def reconstruct_worker_weights(ps_weights, stale_weights, cfg: FedConfig):
     """topk_down: stale client weights + top-k of the diff
     (ref get_new_worker_weights, fed_worker.py:232-247)."""
     diff = ps_weights - stale_weights
-    return stale_weights + topk(diff, cfg.k)
+    return stale_weights + topk(diff, cfg.k, cfg.topk_approx_recall or None)
 
 
 def compute_gradient(apply_loss, unflatten, forward_weights, batch, mask,
@@ -213,7 +213,8 @@ def client_step(apply_loss, unflatten, ps_weights, batch, mask, velocity,
         to_transmit = carrier
 
     if cfg.mode == "local_topk":
-        to_transmit = topk(to_transmit, cfg.k)
+        to_transmit = topk(to_transmit, cfg.k,
+                           cfg.topk_approx_recall or None)
         support = to_transmit != 0
         if cfg.error_type == "local":
             error = jnp.where(support, 0.0, error)   # error feedback

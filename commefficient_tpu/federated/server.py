@@ -77,7 +77,7 @@ def _uncompressed(gradient, state, cfg, lr, noise_rng):
 def _true_topk(gradient, state, cfg, lr):
     v = _momentum(gradient, state.Vvelocity, cfg.virtual_momentum)
     err = state.Verror + v
-    update = topk(err, cfg.k)
+    update = topk(err, cfg.k, cfg.topk_approx_recall or None)
     support = update != 0
     # error feedback + momentum factor masking on the global top-k support
     err = jnp.where(support, 0.0, err)
@@ -98,7 +98,8 @@ def _sketched(sketched_grad, state, cfg, lr, sketch: CountSketch):
     # 'virtual' accumulates; 'none' recovers straight from the momentum table
     # (sketch+'local' is rejected by FedConfig.validate)
     err = state.Verror + v if cfg.error_type == "virtual" else v
-    vals, idxs = topk_values_indices(sketch.estimates(err), cfg.k)
+    vals, idxs = topk_values_indices(sketch.estimates(err), cfg.k,
+                                     cfg.topk_approx_recall or None)
     update = jnp.zeros((cfg.grad_size,)).at[idxs].set(vals)
     # the update's footprint *in sketch space*: re-sketching only the k
     # nonzeros matches sketching the dense update (up to float summation

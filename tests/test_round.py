@@ -215,6 +215,29 @@ def test_sketch_end_to_end_learns():
     assert last["upload_bytes"] == 4.0 * 4 * 5 * ln.cfg.sketch_cols
 
 
+def test_sketch_with_approx_topk_learns():
+    # same pipeline with topk_approx_recall set: approx_max_k selection
+    # must not break convergence (missed coords ride error feedback)
+    rng = np.random.RandomState(0)
+    Xs = rng.randn(64, 8).astype(np.float32)
+    ys = (Xs[:, 0] > 0).astype(np.int32)
+    model = TinyMLP(num_classes=2, hidden=16)
+    cfg = FedConfig(mode="sketch", error_type="virtual", virtual_momentum=0.9,
+                    local_momentum=0, weight_decay=0, num_workers=4,
+                    num_clients=4, lr_scale=0.1, k=50, num_rows=5,
+                    num_cols=2000, topk_approx_recall=0.95)
+    ln = FedLearner(model, cfg, make_cv_loss(model), None,
+                    jax.random.PRNGKey(1), Xs[:1])
+    ids = np.arange(4)
+    batch = (Xs.reshape(4, 16, 8), ys.reshape(4, 16))
+    mask = np.ones((4, 16), np.float32)
+    first = ln.train_round(ids, batch, mask)
+    for _ in range(40):
+        last = ln.train_round(ids, batch, mask)
+    assert last["loss"] < first["loss"] * 0.5
+    assert last["metrics"][0] > 0.9
+
+
 def test_padded_worker_slots_are_inert():
     # Epoch-tail rounds have fewer real clients than num_workers; padded
     # slots (all-zero mask, id aliasing 0) must not transmit, must not

@@ -31,3 +31,30 @@ def test_topk_keeps_signs_and_count():
     vec = jnp.asarray([-5.0, 1.0, 3.0, -2.0, 0.5])
     got = np.asarray(topk(vec, 2))
     np.testing.assert_allclose(got, [-5.0, 0, 3.0, 0, 0])
+
+
+def test_topk_approx_recovers_planted_heavy_hitters():
+    """approx_recall selection (lax.approx_max_k) must find well-separated
+    heavy hitters; ties/near-ties may differ from the exact sort, which is
+    the accepted trade (config.topk_approx_recall docstring)."""
+    rng = np.random.RandomState(2)
+    d, k = 200_000, 100
+    vec = rng.randn(d).astype(np.float32) * 0.01
+    hot = rng.choice(d, k, replace=False)
+    vec[hot] = np.sign(rng.randn(k)) * (5.0 + rng.rand(k))
+    got = np.asarray(topk(jnp.asarray(vec), k, approx_recall=0.95))
+    support = set(np.nonzero(got)[0].tolist())
+    recall = len(support & set(hot.tolist())) / k
+    assert recall >= 0.95, recall
+    # recovered entries keep their exact values
+    for i in support & set(hot.tolist()):
+        assert got[i] == vec[i]
+
+
+def test_topk_approx_values_indices_consistent():
+    from commefficient_tpu.ops.topk import topk_values_indices
+    rng = np.random.RandomState(3)
+    vec = rng.randn(50_000).astype(np.float32)
+    vals, idx = topk_values_indices(jnp.asarray(vec), 64, approx_recall=0.9)
+    np.testing.assert_allclose(np.asarray(vals), vec[np.asarray(idx)],
+                               rtol=1e-6)
