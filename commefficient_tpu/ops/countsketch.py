@@ -149,6 +149,30 @@ def _chunked_route(route, x: jax.Array, off: jax.Array) -> jax.Array:
     return out.reshape(Bp, LANES)[:B]
 
 
+def _permute_xor(x: jax.Array, lanemask: jax.Array) -> jax.Array:
+    """y[b, l] = x[b, l ^ lanemask[b]] as a 7-step butterfly of lane rolls.
+
+    XOR by a 7-bit mask decomposes into per-bit swaps of lanes differing in
+    that bit; each swap is two cyclic lane rotations blended by the lane's
+    own bit, applied only to blocks whose mask has the bit set. 14 rolls +
+    14 selects over (B, L) — O(14*d) data movement with NO blowup
+    intermediate, unlike one-hot routing whose (chunk, L, L) tensor XLA
+    fuses in small programs but materializes inside large ones (observed:
+    the fused federated round read/wrote 75 GB more than its components,
+    3x the round time). XOR is an involution, so the same function serves
+    scatter (values to lanes) and gather (lanes to values)."""
+    lanes = jnp.arange(LANES, dtype=jnp.uint32)
+    for b in range(7):
+        w = 1 << b
+        plus = jnp.roll(x, w, axis=1)      # x[l - w]: for lanes with bit b
+        minus = jnp.roll(x, -w, axis=1)    # x[l + w]: for lanes without
+        swapped = jnp.where(((lanes >> b) & 1).astype(bool)[None, :],
+                            plus, minus)
+        bit = ((lanemask >> jnp.uint32(b)) & 1).astype(bool)[:, None]
+        x = jnp.where(bit, swapped, x)
+    return x
+
+
 def _route_scatter(vals: jax.Array, off: jax.Array) -> jax.Array:
     """(B, L) values + (B, L) lane targets -> (B, L) windows.
 
@@ -289,8 +313,9 @@ class CountSketch:
             rows = []
             for row in range(self.r):
                 signs, off, base = self._row_tiled(row)
-                win = _route_scatter(vp.reshape(self.nblocks, LANES) * signs,
-                                     off)
+                lanemask = off[:, 0].astype(jnp.uint32)  # off[b,l] = l ^ m_b
+                win = _permute_xor(vp.reshape(self.nblocks, LANES) * signs,
+                                   lanemask)
                 rows.append(jax.ops.segment_sum(
                     win, base, num_segments=self.nwindows).reshape(-1))
             return jnp.stack(rows)
