@@ -34,12 +34,17 @@ class FedLearner:
     def __init__(self, module, cfg: FedConfig, loss_train: Callable,
                  loss_val: Optional[Callable], rng: jax.Array,
                  sample_input, lr_schedule: Optional[Callable] = None,
-                 mesh=None, init_params=None, trainable_mask=None):
+                 mesh=None, init_params=None, trainable_mask=None,
+                 lr_scale_vec=None):
         self.module = module
         init_rng, self.rng = jax.random.split(rng)
         if init_params is None:
             variables = module.init(init_rng, sample_input, train=False)
             init_params = variables["params"]
+        if callable(lr_scale_vec):
+            # structure-derived multipliers (e.g. scalar_lr_multipliers)
+            # need the param pytree, which may only exist here
+            lr_scale_vec = lr_scale_vec(init_params)
         flat, unflatten = flatten_params(init_params)
         flat = flat.astype(jnp.float32)
         self.unflatten = unflatten
@@ -56,6 +61,18 @@ class FedLearner:
                                        trainable_mask=trainable_mask)
         self._eval = build_eval_step(loss_val or loss_train, unflatten)
         self.lr_schedule = lr_schedule or (lambda t: cfg.lr_scale)
+        # optional (d,) per-coordinate LR multipliers (the reference's
+        # per-param-group LR vector, fed_aggregator.py:411-427; built from
+        # param structure by utils.params.scalar_lr_multipliers). The round
+        # receives lr * vec — server rules already broadcast a vector lr
+        # over the dense update (federated/server.py docstring).
+        if lr_scale_vec is not None:
+            lr_scale_vec = jnp.asarray(lr_scale_vec, jnp.float32)
+            if lr_scale_vec.shape != (self.cfg.grad_size,):
+                raise ValueError(
+                    f"lr_scale_vec must have shape ({self.cfg.grad_size},), "
+                    f"got {lr_scale_vec.shape}")
+        self.lr_scale_vec = lr_scale_vec
         self.rounds_done = 0
         self.total_download_bytes = 0.0
         self.total_upload_bytes = 0.0
@@ -95,8 +112,9 @@ class FedLearner:
             ids = jax.device_put(ids, ids_sh)
             cols = jax.device_put(cols, cols_sh)
             m = jax.device_put(m, mask_sh)
+        lr_in = lr if self.lr_scale_vec is None else lr * self.lr_scale_vec
         self.state, metrics = self._round(self.state, ids, cols, m,
-                                          lr, round_rng)
+                                          lr_in, round_rng)
         self.rounds_done += 1
         metrics["lr"] = lr
         return metrics
@@ -118,6 +136,7 @@ class FedLearner:
             "download_bytes": float(out["download_bytes"]),
             "upload_bytes": float(out["upload_bytes"]),
             "update_l2": float(out["update_l2"]),
+            "aborted": bool(out["aborted"]),
             "lr": lr,
         }
 

@@ -173,27 +173,16 @@ def _permute_xor(x: jax.Array, lanemask: jax.Array) -> jax.Array:
     return x
 
 
-def _route_scatter(vals: jax.Array, off: jax.Array) -> jax.Array:
-    """(B, L) values + (B, L) lane targets -> (B, L) windows.
-
-    win[b, k] = sum_l vals[b, l] * [off[b, l] == k]. One-hot multiply +
-    reduce, NOT a dot: stays exact f32 (an MXU einsum would round the
-    values to bfloat16 at default precision) and fuses on TPU."""
-    iota = jnp.arange(LANES, dtype=off.dtype)
-
-    def route(v, o):
-        onehot = (o[:, :, None] == iota[None, None, :])
-        return jnp.sum(jnp.where(onehot, v[:, :, None], 0.0), axis=1)
-
-    return _chunked_route(route, vals, off)
-
-
 def _route_gather(win: jax.Array, off: jax.Array) -> jax.Array:
     """(B, L) windows + (B, L) lane sources -> (B, L) values.
 
-    out[b, l] = win[b, off[b, l]]. Same exact one-hot routing as
-    ``_route_scatter`` (a take_along_axis lowers to a slow general gather
-    on TPU: measured 244ms vs <15ms at B=51319)."""
+    out[b, l] = win[b, off[b, l]]. One-hot select + reduce, NOT a dot: it
+    stays exact f32 (an MXU einsum would round the values to bfloat16 at
+    default precision) and fuses on TPU; a take_along_axis would lower to
+    a slow general gather there (measured 244ms vs <15ms at B=51319). The
+    scatter direction needs no routed twin: ``sketch_vec`` scatters via
+    ``_permute_xor`` (the XOR butterfly is an involution, so the same
+    permutation serves both directions)."""
     iota = jnp.arange(LANES, dtype=off.dtype)
 
     def route(w, o):

@@ -50,10 +50,16 @@ DEFAULT_BLOCK_K = 512
 
 
 def supported(q, k, v, causal: bool, kv_mask) -> bool:
-    """Whether the fused kernel handles this call (see module docstring)."""
+    """Whether the fused kernel handles this call (see module docstring).
+
+    Dtype is part of the gate: Mosaic tiling is only exercised (on a real
+    chip: tests/test_flash_attention.py CI runs interpret-mode) for
+    f32/bf16; anything else falls back to the scan formulation."""
     B, Tq, H, D = q.shape
     return (causal and kv_mask is None and k.shape == v.shape
             and q.shape[::2] == k.shape[::2] and D % 8 == 0
+            and q.dtype in (jnp.float32, jnp.bfloat16)
+            and q.dtype == k.dtype == v.dtype
             and Tq == k.shape[1])   # self-attention: q/k share positions
 
 
@@ -385,7 +391,16 @@ def flash_attention(q, k, v, *, causal: bool = True,
                                   "use ops.attention for non-causal")
     B, T, H, D = q.shape
     scale = 1.0 / (D ** 0.5)
-    bq, bk = min(block_q, max(T, 8)), min(block_k, max(T, 8))
+    # block sizes rounded up to a sublane-tile multiple (16 covers both the
+    # f32 sublane of 8 and the bf16 sublane of 16): a ragged T (say 100)
+    # must not become the literal block shape — Mosaic would reject the
+    # unaligned tile on a real chip. _pad_t then pads T to the block, the
+    # kernel masks padded keys via t_k, and padded query rows are sliced
+    # off on return.
+    tile = lambda t: -(-max(t, 8) // 16) * 16
+    # tile() wraps the caller's block too: an explicit block_q=100 must not
+    # reach Mosaic as a 100-row tile any more than a ragged T may
+    bq, bk = tile(min(block_q, T)), tile(min(block_k, T))
 
     def to3(x, block):
         return _pad_t(x.transpose(0, 2, 1, 3).reshape(B * H, T, D), block)

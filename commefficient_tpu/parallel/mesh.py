@@ -35,6 +35,22 @@ from commefficient_tpu.config import FedConfig
 from commefficient_tpu.federated.state import ClientState, ServerOptState
 
 
+def round_up(n: int, multiple: int) -> int:
+    """n rounded up to a multiple — THE padding rule for anything sharded
+    over a mesh axis (client state rows, worker slots)."""
+    return -(-int(n) // int(multiple)) * int(multiple)
+
+
+def padded_num_clients(num_clients: int, mesh: Optional[Mesh],
+                       axis: str = "clients") -> int:
+    """Client state rows must divide the mesh axis; pad with inert rows
+    (samplers only emit real dataset client ids, so padded rows are never
+    gathered or written — memory only)."""
+    if mesh is None:
+        return num_clients
+    return round_up(num_clients, mesh.shape[axis])
+
+
 def make_mesh(n_devices: Optional[int] = None, axis: str = "clients",
               seq: int = 1) -> Mesh:
     devs = jax.devices()
@@ -70,6 +86,7 @@ def fed_state_shardings(cfg: FedConfig, mesh: Mesh, axis: str = "clients"):
         round_idx=rep,
         last_changed=rep,
         client_last_round=row,
+        aborted=rep,
     )
 
 

@@ -64,6 +64,12 @@ def build_parser(default_lr: float = 0.4) -> argparse.ArgumentParser:
     p.add_argument("--fedavg_lr_decay", type=float, default=1.0)
     p.add_argument("--error_type", choices=ERROR_TYPES, default="none")
     p.add_argument("--lr_scale", type=float, default=default_lr)
+    p.add_argument("--scalar_lr_factor", type=float, default=None,
+                   help="LR multiplier for scalar (size-1) params — the "
+                        "Fixup recipe trains bias/scale scalars at 0.1x "
+                        "(ref fed_aggregator.py:411-427 per-group LR "
+                        "vector). Default: 0.1 for Fixup* models, 1.0 "
+                        "otherwise")
     p.add_argument("--pivot_epoch", type=float, default=5)
     p.add_argument("--max_grad_norm", type=float, default=None)
     # federated dimensions + mesh
@@ -75,7 +81,8 @@ def build_parser(default_lr: float = 0.4) -> argparse.ArgumentParser:
     p.add_argument("--microbatch_size", type=int, default=-1)
     p.add_argument("--iid", action="store_true", dest="do_iid")
     p.add_argument("--mesh", type=str, default="",
-                   help="mesh shape as 'clients=N' (default: all devices)")
+                   help="mesh shape as 'clients=N[,seq=M]' or 'clients=all';"
+                        " empty = single-device (no mesh). See parse_mesh")
     # GPT2 / PersonaChat (ref utils.py:185-208)
     p.add_argument("--model_checkpoint", type=str, default="gpt2")
     p.add_argument("--num_candidates", type=int, default=2)
@@ -96,3 +103,54 @@ def args_to_config(args, **overrides) -> FedConfig:
     kwargs = {k: v for k, v in vars(args).items() if k in fields}
     kwargs.update(overrides)
     return FedConfig(**kwargs)
+
+
+def parse_mesh(spec: str):
+    """``--mesh`` string -> ``jax.sharding.Mesh`` (or None for no mesh).
+
+    Grammar: ``clients=N[,seq=M]`` — the TPU analog of the reference's
+    process-topology flags (num_devices/share_ps_gpu, ref utils.py:175).
+    ``clients=all`` (or ``auto``) uses every visible device. The mesh is
+    built over the first N*M of ``jax.devices()``.
+    """
+    if not spec:
+        return None
+    from commefficient_tpu.parallel.mesh import make_mesh
+    kv = {}
+    for part in spec.split(","):
+        key, sep, val = part.partition("=")
+        if not sep:
+            raise ValueError(f"--mesh: expected key=value, got {part!r}")
+        kv[key.strip()] = val.strip()
+    unknown = set(kv) - {"clients", "seq"}
+    if unknown:
+        raise ValueError(f"--mesh: unknown axes {sorted(unknown)} "
+                         f"(supported: clients=N[,seq=M])")
+    seq = int(kv.get("seq", 1))
+    if seq <= 0:
+        raise ValueError(f"--mesh: seq must be positive, got {seq}")
+    clients = kv.get("clients", "all")
+    if clients in ("all", "auto"):
+        return make_mesh(None, seq=seq)
+    n = int(clients)
+    if n <= 0:
+        raise ValueError(f"--mesh: clients must be positive, got {n}")
+    return make_mesh(n * seq, seq=seq)
+
+
+def round_up_workers_for_mesh(args, mesh) -> int:
+    """Number of mesh shards along ``clients``; loudly rounds
+    ``args.num_workers`` up to a multiple of it (the batch worker axis is
+    sharded over that mesh axis, so its width must divide evenly — the
+    reference instead silently DROPS the tail chunk when procs don't divide
+    clients, fed_aggregator.py:230-237, a quirk SURVEY.md says not to keep)."""
+    if mesh is None:
+        return 1
+    from commefficient_tpu.parallel.mesh import round_up
+    n_shards = mesh.shape["clients"]
+    if args.num_workers % n_shards:
+        padded = round_up(args.num_workers, n_shards)
+        print(f"--mesh: rounding num_workers {args.num_workers} -> {padded} "
+              f"(must be a multiple of the {n_shards}-way 'clients' axis)")
+        args.num_workers = padded
+    return n_shards

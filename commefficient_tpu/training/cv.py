@@ -46,9 +46,11 @@ def make_dataset(args, train: bool):
 
 
 def build_learner(args, sample_input, num_classes, channels, mesh=None):
+    from commefficient_tpu.parallel.mesh import padded_num_clients
+    num_clients = padded_num_clients(args.num_clients, mesh)
     cfg = args_to_config(args, num_classes=num_classes,
                          num_channels=channels,
-                         num_clients=args.num_clients)
+                         num_clients=num_clients)
     model_kw = dict(num_classes=num_classes)
     compute_dtype = getattr(args, "compute_dtype", "float32")
     if args.model in ("ResNet9",):
@@ -74,9 +76,21 @@ def build_learner(args, sample_input, num_classes, channels, mesh=None):
         init_params, trainable_mask = load_pretrained_for_finetune(
             model, jax.random.PRNGKey(args.seed), sample_input,
             args.finetune_path)
+    # per-coordinate LR: Fixup scalars train at a reduced LR (the
+    # reference's per-param-group LR vector, fed_aggregator.py:411-427)
+    factor = args.scalar_lr_factor
+    if factor is None:
+        factor = 0.1 if args.model.startswith("Fixup") else 1.0
+    lr_vec = None
+    if factor != 1.0:
+        from functools import partial
+
+        from commefficient_tpu.utils.params import scalar_lr_multipliers
+        lr_vec = partial(scalar_lr_multipliers, scalar_factor=factor)
     return FedLearner(model, cfg, loss, loss, jax.random.PRNGKey(args.seed),
                       sample_input, lr_schedule=sched, mesh=mesh,
-                      init_params=init_params, trainable_mask=trainable_mask)
+                      init_params=init_params, trainable_mask=trainable_mask,
+                      lr_scale_vec=lr_vec)
 
 
 def train(args, mesh=None, max_rounds=None, log=True):
@@ -108,15 +122,21 @@ def train(args, mesh=None, max_rounds=None, log=True):
             # one-round software pipeline (RoundPipeline): metric sync
             # overlaps the next round's device compute, so the loop runs
             # at device throughput (bench.py's round_throughput_ms). The
-            # NaN abort (ref cv_train.py:110-112) therefore lags one round.
+            # host notices a NaN (ref cv_train.py:110-112) one round late,
+            # but the in-round device guard (round.py) makes the breaching
+            # round and everything after it a state no-op, so the lag
+            # never pollutes weights/state/byte accounting.
             pipe = learner.pipeline()
 
             def check(out):
                 if out is None:
                     return None
                 epoch_metrics.append(out)
-                if not math.isfinite(out["loss"]) or \
-                        out["loss"] > args.nan_threshold:
+                # the device guard's verdict, not a host loss recompute: a
+                # pipelined round AFTER the breach can report a healthy
+                # loss again (the guard froze the weights), so the latched
+                # flag is the only reliable signal
+                if out["aborted"]:
                     print(f"NaN/divergent loss ({out['loss']}); aborting "
                           f"(threshold {args.nan_threshold})")
                     return out
@@ -180,6 +200,8 @@ def train(args, mesh=None, max_rounds=None, log=True):
 
 
 def main(argv=None):
+    from commefficient_tpu.training.args import (parse_mesh,
+                                                 round_up_workers_for_mesh)
     parser = build_parser(default_lr=0.4)
     args = parser.parse_args(argv)
     if args.do_test:
@@ -188,10 +210,12 @@ def main(argv=None):
         args.num_cols = min(args.num_cols, 100)
         args.num_rows = min(args.num_rows, 1)
         args.num_epochs = 1
+    mesh = parse_mesh(args.mesh)
+    round_up_workers_for_mesh(args, mesh)
     np.random.seed(args.seed)
     from commefficient_tpu.utils.logging import profile_ctx
     with profile_ctx(args.profile):
-        _, final = train(args)
+        _, final = train(args, mesh=mesh)
     print("final:", {k: round(v, 4) if isinstance(v, float) else v
                      for k, v in final.items()})
     return 0

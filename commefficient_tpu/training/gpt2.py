@@ -59,11 +59,13 @@ def make_persona(args, tokenizer, train: bool):
     return SyntheticPersona(**kw)
 
 
-def train(args, max_rounds=None, log=True):
+def train(args, mesh=None, max_rounds=None, log=True):
     tokenizer = get_tokenizer(args.model_checkpoint)
     train_set = make_persona(args, tokenizer, train=True)
     val_set = make_persona(args, tokenizer, train=False)
     args.num_clients = train_set.num_clients
+    from commefficient_tpu.parallel.mesh import padded_num_clients
+    num_clients = padded_num_clients(args.num_clients, mesh)
 
     gcfg = (GPT2Config.small(vocab_size=tokenizer.vocab_size)
             if args.model == "gpt2" else
@@ -85,7 +87,7 @@ def train(args, max_rounds=None, log=True):
     # init shapes straight from the dataset — materializing a batcher round
     # here would advance the sampler RNG and change epoch 1's sampling
     sample = tuple(c[:1] for c in train_set.get_flat_batch(np.arange(1)))
-    cfg = args_to_config(args, num_clients=args.num_clients,
+    cfg = args_to_config(args, num_clients=num_clients,
                          max_seq_len=args.max_seq_len)
     loss_tr = make_gpt2_train_loss(model, args.lm_coef, args.mc_coef)
     loss_val = make_gpt2_val_loss(model)
@@ -124,7 +126,8 @@ def train(args, max_rounds=None, log=True):
 
     learner = FedLearner(_Wrap(), cfg, loss_tr, loss_val,
                          jax.random.PRNGKey(args.seed), sample_in,
-                         lr_schedule=sched, init_params=init_params)
+                         lr_schedule=sched, mesh=mesh,
+                         init_params=init_params)
 
     table = TableLogger() if log else None
     writer = None
@@ -148,11 +151,16 @@ def train(args, max_rounds=None, log=True):
                     return False
                 out = o
                 losses.append(o["loss"])
-                return not math.isfinite(o["loss"])
+                # device guard verdict (round.py): covers NaN and the
+                # nan_threshold breach; a later pipelined round's loss can
+                # look finite again because the guard froze the weights
+                return o["aborted"]
 
             # next round's batch transfers while this one computes
+            # (sharding-aware on a mesh: lands directly on the shards)
             from commefficient_tpu.data.prefetch import device_prefetch
-            for ids, cols, mask in device_prefetch(batcher.epoch()):
+            for ids, cols, mask in device_prefetch(
+                    batcher.epoch(), shardings=learner.batch_shardings):
                 raw = learner.train_round_async(ids, cols, mask,
                                                 epoch_frac=total_rounds)
                 total_rounds += 1
@@ -250,10 +258,14 @@ def main(argv=None):
         args.k = min(args.k, 10)
         args.num_cols = min(args.num_cols, 100)
         args.num_rows = min(args.num_rows, 1)
+    from commefficient_tpu.training.args import (parse_mesh,
+                                                 round_up_workers_for_mesh)
+    mesh = parse_mesh(args.mesh)
+    round_up_workers_for_mesh(args, mesh)
     np.random.seed(args.seed)
     from commefficient_tpu.utils.logging import profile_ctx
     with profile_ctx(args.profile):
-        _, final = train(args)
+        _, final = train(args, mesh=mesh)
     print("final:", {k: round(v, 4) if isinstance(v, float) else v
                      for k, v in final.items()})
     return 0

@@ -49,11 +49,15 @@ def load_checkpoint(fn: str, learner) -> None:
     with np.load(fn) as z:
         flat, treedef = _state_arrays(learner.state)
         n_saved = sum(1 for k in z.files if k.startswith("arr_"))
-        if n_saved != len(flat):
+        restored = [z[f"arr_{i}"] for i in range(n_saved)]
+        if n_saved == len(flat) - 1 and flat[-1].shape == ():
+            # pre-NaN-guard checkpoint: FedState gained a trailing scalar
+            # `aborted` leaf; backfill False so old checkpoints keep loading
+            restored.append(np.zeros((), bool))
+        elif n_saved != len(flat):
             raise ValueError(
                 f"checkpoint {fn} has {n_saved} state arrays, learner "
                 f"expects {len(flat)} — config/mode mismatch")
-        restored = [z[f"arr_{i}"] for i in range(len(flat))]
         for i, (cur, new) in enumerate(zip(flat, restored)):
             if tuple(cur.shape) != tuple(new.shape):
                 raise ValueError(

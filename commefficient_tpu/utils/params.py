@@ -34,3 +34,23 @@ def make_unflatten(params: Any) -> Callable[[jax.Array], Any]:
 
 def grad_size_of(params: Any) -> int:
     return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+
+
+def scalar_lr_multipliers(params: Any, scalar_factor: float) -> jax.Array:
+    """(d,) per-coordinate LR multipliers: ``scalar_factor`` for scalar
+    parameters (size 1), 1.0 elsewhere, in ``flatten_params`` order.
+
+    The Fixup recipe: the scalar biases/scales train at a reduced LR
+    (canonically 0.1x) while convolution weights take the full LR. The
+    reference carries this as per-param-group LRs concatenated into a
+    vector in param order (reference fed_aggregator.py:411-427); here the
+    grouping is structural — exactly the size-1 leaves that Fixup inserts
+    (FixupLayer Add/Mul scalars) — so no group bookkeeping is needed.
+    Multiply by the scheduled scalar LR each round (FedLearner does this
+    when built with ``lr_scale_vec``)."""
+    mults = jax.tree.map(
+        lambda p: jnp.full(p.shape,
+                           scalar_factor if p.size == 1 else 1.0,
+                           jnp.float32), params)
+    vec, _ = ravel_pytree(mults)
+    return vec

@@ -451,3 +451,40 @@ def test_topk_down_reconstructs_stale_weights():
     w0 = np.asarray(ln_down.state.clients.weights)
     np.testing.assert_array_equal(w0[0], w_before)
     assert not np.allclose(w0[2], w_before)
+
+
+def test_nan_guard_breaching_round_is_a_state_noop():
+    # The reference checks the round's loss BEFORE opt.step
+    # (cv_train.py:221-229), so a breaching round never updates weights.
+    # The device-side guard restores exactly that under the async pipeline:
+    # a round whose mean loss exceeds nan_threshold (or is non-finite)
+    # leaves ALL state untouched, transfers no bytes, and latches `aborted`
+    # so every later round is a no-op too.
+    cfg = FedConfig(mode="uncompressed", virtual_momentum=0.9,
+                    local_momentum=0, error_type="none", weight_decay=0,
+                    num_workers=1, lr_scale=0.02, nan_threshold=1.0)
+    ln = toy_learner(cfg)
+    ids, batch, mask = one_worker_batch()
+    # round 1: mean loss 3.5 > threshold 1.0 -> guard trips
+    out = ln.train_round(ids, batch, mask)
+    assert out["loss"] == pytest.approx(3.5, abs=1e-5)  # loss still reported
+    assert weight(ln) == 0.0                            # update NOT applied
+    assert out["upload_bytes"] == 0 and out["download_bytes"] == 0
+    assert bool(ln.state.aborted)
+    assert int(ln.state.round_idx) == 0
+    assert float(ln.state.opt.Vvelocity[0]) == 0.0
+    # rounds dispatched after the breach (pipeline lag) are inert
+    ln.train_round(ids, batch, mask)
+    assert weight(ln) == 0.0 and int(ln.state.round_idx) == 0
+
+
+def test_nan_guard_healthy_path_untouched():
+    cfg = FedConfig(mode="uncompressed", virtual_momentum=0.9,
+                    local_momentum=0, error_type="none", weight_decay=0,
+                    num_workers=1, lr_scale=0.02, nan_threshold=999.0)
+    ln = toy_learner(cfg)
+    ids, batch, mask = one_worker_batch()
+    ln.train_round(ids, batch, mask)
+    assert weight(ln) == pytest.approx(0.14, abs=1e-6)
+    assert not bool(ln.state.aborted)
+    assert int(ln.state.round_idx) == 1
