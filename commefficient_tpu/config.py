@@ -98,14 +98,27 @@ class FedConfig:
     l2_norm_clip: float = 1.0
     noise_multiplier: float = 0.0
 
-    # derived (set by finalize)
+    # derived (set by finalize). grad_size is the LOGICAL model dimension
+    # (what byte accounting charges — reference fed_aggregator.py:291-299);
+    # grad_size_pad is the PHYSICAL flat-vector length, rounded up so a
+    # 'model' mesh axis can coordinate-split it evenly (pad coordinates
+    # are permanently zero: no gradient, no decay, no updates).
     grad_size: int = 0
+    grad_size_pad: int = 0
 
-    def finalize(self, grad_size: int) -> "FedConfig":
+    def finalize(self, grad_size: int, pad_to: int = 1) -> "FedConfig":
         """Return a copy with derived fields filled in and invariants checked."""
-        cfg = dataclasses.replace(self, grad_size=int(grad_size))
+        from commefficient_tpu.utils.params import round_up
+        cfg = dataclasses.replace(self, grad_size=int(grad_size),
+                                  grad_size_pad=round_up(grad_size, pad_to))
         cfg.validate()
         return cfg
+
+    @property
+    def grad_dim(self) -> int:
+        """Physical flat-vector length (falls back to grad_size for
+        configs built without finalize)."""
+        return self.grad_size_pad or self.grad_size
 
     def validate(self) -> None:
         if self.mode not in MODES:
@@ -159,7 +172,7 @@ class FedConfig:
         """Shape of the quantity a worker transmits (ref fed_worker.py:44-48)."""
         if self.mode == "sketch":
             return (self.num_rows, self.sketch_cols)
-        return (self.grad_size,)
+        return (self.grad_dim,)
 
     @property
     def needs_velocity_state(self) -> bool:

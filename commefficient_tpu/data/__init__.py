@@ -3,6 +3,7 @@ from commefficient_tpu.data.cifar import FedCIFAR10, FedCIFAR100
 from commefficient_tpu.data.emnist import FedEMNIST
 from commefficient_tpu.data.imagenet import FedImageNet
 from commefficient_tpu.data.synthetic import SyntheticCV
+from commefficient_tpu.data.offline import FedDigits, FedPatches32
 from commefficient_tpu.data.sampler import FedSampler
 from commefficient_tpu.data.batching import FedBatcher, val_batches
 
@@ -12,8 +13,10 @@ fed_datasets = {
     "EMNIST": FedEMNIST,
     "ImageNet": FedImageNet,
     "Synthetic": SyntheticCV,
+    "Digits": FedDigits,
+    "Patches32": FedPatches32,
 }
 
 __all__ = ["FedDataset", "FedCIFAR10", "FedCIFAR100", "FedEMNIST",
-           "FedImageNet", "SyntheticCV", "FedSampler", "FedBatcher",
-           "val_batches", "fed_datasets"]
+           "FedImageNet", "SyntheticCV", "FedDigits", "FedPatches32",
+           "FedSampler", "FedBatcher", "val_batches", "fed_datasets"]

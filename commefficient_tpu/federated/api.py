@@ -35,7 +35,7 @@ class FedLearner:
                  loss_val: Optional[Callable], rng: jax.Array,
                  sample_input, lr_schedule: Optional[Callable] = None,
                  mesh=None, init_params=None, trainable_mask=None,
-                 lr_scale_vec=None):
+                 lr_scale_vec=None, param_specs=None):
         self.module = module
         init_rng, self.rng = jax.random.split(rng)
         if init_params is None:
@@ -47,8 +47,20 @@ class FedLearner:
             lr_scale_vec = lr_scale_vec(init_params)
         flat, unflatten = flatten_params(init_params)
         flat = flat.astype(jnp.float32)
+        d_logical = flat.shape[0]
+        pad_to = 1
+        if mesh is not None and "model" in mesh.axis_names:
+            # the flat vector is coordinate-split over the model axis, so
+            # its physical length must divide evenly; pad coordinates are
+            # invisible (unflatten slices them off, so they get no grads,
+            # no decay, no updates) and never charged to byte accounting
+            pad_to = mesh.shape["model"]
+        self.cfg = cfg.finalize(d_logical, pad_to=pad_to)
+        if self.cfg.grad_dim != d_logical:
+            flat = jnp.pad(flat, (0, self.cfg.grad_dim - d_logical))
+            base_unflatten = unflatten
+            unflatten = lambda fp: base_unflatten(fp[:d_logical])  # noqa: E731
         self.unflatten = unflatten
-        self.cfg = cfg.finalize(flat.shape[0])
         self.mesh = mesh
         self.state: FedState = init_fed_state(self.cfg, flat)
         if mesh is not None:
@@ -56,7 +68,31 @@ class FedLearner:
                                                          shard_state)
             self.state = shard_state(self.state, self.cfg, mesh)
             self._batch_sh = batch_shardings(mesh)
-        self._round = build_round_step(loss_train, unflatten, self.cfg,
+        round_unflatten = unflatten
+        if (mesh is not None and param_specs is not None
+                and "model" in mesh.axis_names):
+            # 2D clients x model federation: the flat weight vector is
+            # STORED coordinate-split over the model axis
+            # (parallel/mesh.fed_state_shardings), but the model should
+            # COMPUTE in its tensor-parallel layout (e.g. parallel/tp.py's
+            # Megatron specs). Re-constrain each unflattened leaf so GSPMD
+            # resharding happens once per round, then the matmuls run TP.
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as _P
+
+            def round_unflatten(flat):
+                tree = unflatten(flat)
+                return jax.tree.map(
+                    lambda x, s: jax.lax.with_sharding_constraint(
+                        x, NamedSharding(mesh, s)),
+                    tree, param_specs,
+                    is_leaf=lambda x: isinstance(x, _P))
+        if (trainable_mask is not None
+                and self.cfg.grad_dim != d_logical):
+            trainable_mask = jnp.pad(
+                jnp.asarray(trainable_mask, jnp.float32),
+                (0, self.cfg.grad_dim - d_logical))  # pads stay frozen
+        self._round = build_round_step(loss_train, round_unflatten, self.cfg,
                                        mesh=mesh,
                                        trainable_mask=trainable_mask)
         self._eval = build_eval_step(loss_val or loss_train, unflatten)
@@ -72,6 +108,10 @@ class FedLearner:
                 raise ValueError(
                     f"lr_scale_vec must have shape ({self.cfg.grad_size},), "
                     f"got {lr_scale_vec.shape}")
+            if self.cfg.grad_dim != d_logical:
+                lr_scale_vec = jnp.pad(
+                    lr_scale_vec, (0, self.cfg.grad_dim - d_logical),
+                    constant_values=1.0)
         self.lr_scale_vec = lr_scale_vec
         self.rounds_done = 0
         self.total_download_bytes = 0.0

@@ -45,7 +45,7 @@ def init_server_opt_state(cfg: FedConfig) -> ServerOptState:
 
 def make_sketch(cfg: FedConfig) -> CountSketch:
     """Sketch with hashes shared by clients and server (ref args2sketch :464)."""
-    return CountSketch(d=cfg.grad_size, c=cfg.num_cols, r=cfg.num_rows,
+    return CountSketch(d=cfg.grad_dim, c=cfg.num_cols, r=cfg.num_rows,
                        seed=42, num_blocks=cfg.num_blocks,
                        scheme=cfg.sketch_scheme)
 
@@ -100,7 +100,7 @@ def _sketched(sketched_grad, state, cfg, lr, sketch: CountSketch):
     err = state.Verror + v if cfg.error_type == "virtual" else v
     vals, idxs = topk_values_indices(sketch.estimates(err), cfg.k,
                                      cfg.topk_approx_recall or None)
-    update = jnp.zeros((cfg.grad_size,)).at[idxs].set(vals)
+    update = jnp.zeros((cfg.grad_dim,)).at[idxs].set(vals)
     # the update's footprint *in sketch space*: re-sketching only the k
     # nonzeros matches sketching the dense update (up to float summation
     # order) and is ~130x cheaper at the default d=6.5M/k=50k

@@ -397,7 +397,8 @@ def flash_attention(q, k, v, *, causal: bool = True,
     # unaligned tile on a real chip. _pad_t then pads T to the block, the
     # kernel masks padded keys via t_k, and padded query rows are sliced
     # off on return.
-    tile = lambda t: -(-max(t, 8) // 16) * 16
+    from commefficient_tpu.utils.params import round_up
+    tile = lambda t: round_up(max(t, 8), 16)
     # tile() wraps the caller's block too: an explicit block_q=100 must not
     # reach Mosaic as a 100-row tile any more than a ragged T may
     bq, bk = tile(min(block_q, T)), tile(min(block_k, T))

@@ -35,10 +35,8 @@ from commefficient_tpu.config import FedConfig
 from commefficient_tpu.federated.state import ClientState, ServerOptState
 
 
-def round_up(n: int, multiple: int) -> int:
-    """n rounded up to a multiple — THE padding rule for anything sharded
-    over a mesh axis (client state rows, worker slots)."""
-    return -(-int(n) // int(multiple)) * int(multiple)
+from commefficient_tpu.utils.params import round_up  # noqa: F401  (re-export:
+# the padding rule is shared with config.finalize and kernel tiling)
 
 
 def padded_num_clients(num_clients: int, mesh: Optional[Mesh],
@@ -52,16 +50,20 @@ def padded_num_clients(num_clients: int, mesh: Optional[Mesh],
 
 
 def make_mesh(n_devices: Optional[int] = None, axis: str = "clients",
-              seq: int = 1) -> Mesh:
+              seq: int = 1, model: int = 1) -> Mesh:
     devs = jax.devices()
     n = n_devices or len(devs)
     if n > len(devs):
         raise ValueError(f"asked for {n} devices, have {len(devs)}")
-    if seq > 1:
-        if n % seq:
-            raise ValueError("n_devices must be divisible by seq")
-        arr = np.array(devs[:n]).reshape(n // seq, seq)
-        return Mesh(arr, (axis, "seq"))
+    if seq > 1 and model > 1:
+        raise ValueError("choose ONE inner axis: seq (ring attention) or "
+                         "model (tensor parallelism)")
+    for name, size in (("seq", seq), ("model", model)):
+        if size > 1:
+            if n % size:
+                raise ValueError(f"n_devices must be divisible by {name}")
+            arr = np.array(devs[:n]).reshape(n // size, size)
+            return Mesh(arr, (axis, name))
     return Mesh(np.array(devs[:n]), (axis,))
 
 
@@ -70,22 +72,42 @@ def _ns(mesh, *spec):
 
 
 def fed_state_shardings(cfg: FedConfig, mesh: Mesh, axis: str = "clients"):
-    """Sharding pytree matching FedState (see round.FedState)."""
+    """Sharding pytree matching FedState (see round.FedState).
+
+    With a ``model`` axis in the mesh (2D clients x model federation), the
+    flat weight-vector quantities shard their coordinate dimension over it:
+    weights/last_changed (d,), the server opt state, and the SECOND dim of
+    per-client rows (n, d) — so a model too big for one chip can still be
+    federated (the capability the reference approximates by giving each
+    client a whole GPU, fed_worker.py:18-20). The flat-coordinate split is
+    a storage layout, not the compute layout: the round's ``unflatten``
+    re-constrains params to the Megatron TP specs (parallel/tp.py), and
+    GSPMD inserts the reshard."""
     from commefficient_tpu.federated.round import FedState
+    m = "model" if "model" in mesh.axis_names else None
     rep = _ns(mesh)
-    row = _ns(mesh, axis)
+    vec = _ns(mesh, m) if m else rep           # (d,)-shaped quantities
+    row = _ns(mesh, axis, m) if m else _ns(mesh, axis)  # (num_clients, d)
+    if cfg.mode == "sketch":
+        # (r, c) sketch tables: shard columns over the model axis only
+        # when c divides evenly (the tiled scheme's 128-multiple covers
+        # power-of-two axes; anything else replicates — tables are small)
+        cols_divide = m and cfg.sketch_cols % mesh.shape["model"] == 0
+        opt_sh = _ns(mesh, None, m) if cols_divide else rep
+    else:
+        opt_sh = vec
     clients = ClientState(
         velocities=row if cfg.needs_velocity_state else None,
         errors=row if cfg.needs_error_state else None,
         weights=row if cfg.needs_client_weights else None,
     )
     return FedState(
-        weights=rep,
-        opt=ServerOptState(Vvelocity=rep, Verror=rep),
+        weights=vec,
+        opt=ServerOptState(Vvelocity=opt_sh, Verror=opt_sh),
         clients=clients,
         round_idx=rep,
-        last_changed=rep,
-        client_last_round=row,
+        last_changed=vec,
+        client_last_round=_ns(mesh, axis),
         aborted=rep,
     )
 

@@ -13,10 +13,12 @@ Scaling story: per-device activation memory falls as T/n_seq, enabling
 contexts n_seq times longer than one chip's HBM allows; ring traffic rides
 ICI neighbor links and overlaps with per-block attention compute.
 
-Note on dropout: inside shard_map every shard derives the same rng from
-``rngs``, so dropout masks repeat across sequence shards (they would be
-independent unsharded). Use for eval/inference or with dropout=0 when
-exact training-distribution parity matters.
+Note on dropout: each shard folds its mesh position into the dropout rng
+(``_shard_rngs``), so masks are independent across sequence and
+data-parallel shards — the same distribution the unsharded model draws
+(every position's keep-bit is iid Bernoulli; only the realization
+differs). Without the fold, all shards would reuse one mask pattern —
+correlated regularization noise across shard boundaries.
 """
 
 from __future__ import annotations
@@ -27,6 +29,17 @@ import jax
 import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
+
+
+def _shard_rngs(rngs, *axis_names):
+    """Fold this device's mesh position into every rng so stochastic ops
+    (dropout) decorrelate across shards; call INSIDE shard_map."""
+    if rngs is None:
+        return None
+    idx = jnp.int32(0)
+    for a in axis_names:
+        idx = idx * jax.lax.psum(1, a) + jax.lax.axis_index(a)
+    return {k: jax.random.fold_in(v, idx) for k, v in rngs.items()}
 
 
 def seq_parallel_apply(mesh, model, params, input_ids, token_type_ids,
@@ -57,7 +70,7 @@ def seq_parallel_apply(mesh, model, params, input_ids, token_type_ids,
              check_vma=False)
     def run(ids, types, mc_ids):
         return model.apply({"params": params}, ids, types, mc_ids,
-                           train=train, rngs=rngs)
+                           train=train, rngs=_shard_rngs(rngs, axis_name))
 
     return run(input_ids, token_type_ids, mc_token_ids)
 
@@ -81,11 +94,11 @@ def seq_dp_lm_train_step(mesh, model, params, input_ids, token_type_ids,
     Returns (mean nll over labeled tokens, grads pytree) — both
     replicated.
 
-    ``train=True`` enables dropout (pass ``rngs={'dropout': key}``), with
-    the module-docstring caveat extended to BOTH axes: the closed-over rng
-    is identical on every device, so masks repeat across sequence shards
-    AND across data-parallel shards (different batch rows get correlated
-    masks). Default is eval-mode gradients (exact, dropout-free).
+    ``train=True`` enables dropout (pass ``rngs={'dropout': key}``); each
+    shard folds its (dp, seq) mesh position into the key (``_shard_rngs``),
+    so masks are independent across both axes — the distribution the
+    unsharded model draws. Default is eval-mode gradients (exact,
+    dropout-free).
     """
     if model.config.attn_impl != "ring":
         raise ValueError("seq_dp_lm_train_step requires attn_impl='ring'")
@@ -102,9 +115,11 @@ def seq_dp_lm_train_step(mesh, model, params, input_ids, token_type_ids,
                        P(dp_axis, None)),
              out_specs=(P(), P()), check_vma=False)
     def step(p, ids, types, labs, mc):
+        local_rngs = _shard_rngs(rngs, dp_axis, axis_name)
+
         def local_loss(p):
             lm, _ = model.apply({"params": p}, ids, types, mc,
-                                train=train, rngs=rngs)
+                                train=train, rngs=local_rngs)
             lp = jax.nn.log_softmax(lm.astype(jnp.float32), axis=-1)
             valid = labs >= 0
             tgt = jnp.where(valid, labs, 0)

@@ -30,7 +30,7 @@ def build_parser(default_lr: float = 0.4) -> argparse.ArgumentParser:
                    choices=sorted(MODEL_REGISTRY))
     p.add_argument("--dataset_name", default="Synthetic",
                    choices=["CIFAR10", "CIFAR100", "EMNIST", "ImageNet",
-                            "Synthetic", "PERSONA"])
+                            "Synthetic", "PERSONA", "Digits", "Patches32"])
     p.add_argument("--dataset_dir", default="./dataset")
     p.add_argument("--batchnorm", action="store_true", dest="do_batchnorm")
     p.add_argument("--nan_threshold", type=float, default=999)

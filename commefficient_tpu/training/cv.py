@@ -28,8 +28,9 @@ from commefficient_tpu.utils.logging import TableLogger, Timer
 from commefficient_tpu.utils.schedules import cifar_lr_schedule
 
 DATASET_CLASSES = {"CIFAR10": 10, "CIFAR100": 100, "EMNIST": 62,
-                   "ImageNet": 1000, "Synthetic": 10}
-DATASET_CHANNELS = {"EMNIST": 1}
+                   "ImageNet": 1000, "Synthetic": 10, "Digits": 10,
+                   "Patches32": 10}
+DATASET_CHANNELS = {"EMNIST": 1, "Digits": 1}
 
 
 def make_dataset(args, train: bool):

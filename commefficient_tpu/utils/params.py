@@ -36,6 +36,12 @@ def grad_size_of(params: Any) -> int:
     return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
 
 
+def round_up(n: int, multiple: int) -> int:
+    """n rounded up to a multiple — THE padding rule (mesh-axis shard
+    counts, flat-vector model-axis padding, kernel tile alignment)."""
+    return -(-int(n) // int(multiple)) * int(multiple)
+
+
 def scalar_lr_multipliers(params: Any, scalar_factor: float) -> jax.Array:
     """(d,) per-coordinate LR multipliers: ``scalar_factor`` for scalar
     parameters (size 1), 1.0 elsewhere, in ``flatten_params`` order.
