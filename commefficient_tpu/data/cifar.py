@@ -9,13 +9,12 @@ a clear error tells the user where to put the files.
 
 from __future__ import annotations
 
-import json
 import os
 import pickle
 
 import numpy as np
 
-from commefficient_tpu.data.fed_dataset import FedDataset
+from commefficient_tpu.data.fed_dataset import PreparedArrayDataset
 
 
 def _load_cifar10_raw(root):
@@ -46,59 +45,19 @@ def _load_cifar100_raw(root):
             np.asarray(te["fine_labels"]), 100)
 
 
-class FedCIFAR10(FedDataset):
+class FedCIFAR10(PreparedArrayDataset):
     _loader = staticmethod(_load_cifar10_raw)
     name = "CIFAR10"
 
-    def __init__(self, *args, **kw):
-        super().__init__(*args, **kw)
-        if self.train:
-            self.client_datasets = [
-                np.load(self.client_fn(c))
-                for c in range(len(self.images_per_client))]
-        else:
-            with np.load(self.test_fn()) as t:
-                self.test_images = t["test_images"]
-                self.test_targets = t["test_targets"]
-
-    def client_fn(self, client_id: int) -> str:
-        return os.path.join(self.dataset_dir, f"client{client_id}.npy")
-
-    def test_fn(self) -> str:
-        return os.path.join(self.dataset_dir, "test.npz")
-
-    def prepare_datasets(self):
-        os.makedirs(self.dataset_dir, exist_ok=True)
+    def _make_xy(self):
         try:
-            train_x, train_y, test_x, test_y, n_cls = self._loader(
-                self.dataset_dir)
+            return self._loader(self.dataset_dir)
         except FileNotFoundError as e:
             raise FileNotFoundError(
                 f"{self.name} raw files not found under {self.dataset_dir} "
                 f"(no downloader in this offline environment — place the "
                 f"python-pickle batches there, or use --dataset_name "
                 f"Synthetic): {e}") from None
-        images_per_client = []
-        for c in range(n_cls):
-            rows = train_x[train_y == c]
-            images_per_client.append(len(rows))
-            fn = self.client_fn(c)
-            if os.path.exists(fn):
-                raise RuntimeError("won't overwrite existing split")
-            np.save(fn, rows)
-        np.savez(self.test_fn(), test_images=test_x, test_targets=test_y)
-        with open(self.stats_fn(), "w") as f:
-            json.dump({"images_per_client": images_per_client,
-                       "num_val_images": len(test_y)}, f)
-
-    def _get_train_batch(self, client_id: int, idxs: np.ndarray):
-        imgs = self.client_datasets[client_id][idxs]
-        # target == natural client id == the class (ref fed_cifar.py:79-81)
-        return imgs, np.full(len(idxs), client_id, np.int32)
-
-    def _get_val_batch(self, idxs: np.ndarray):
-        return (self.test_images[idxs],
-                self.test_targets[idxs].astype(np.int32))
 
 
 class FedCIFAR100(FedCIFAR10):

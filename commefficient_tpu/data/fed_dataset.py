@@ -135,3 +135,59 @@ class FedDataset:
         cumsum = np.cumsum(self.data_per_client)
         starts = np.hstack([[0], cumsum[:-1]])
         return list(zip(starts.tolist(), cumsum.tolist()))
+
+
+class PreparedArrayDataset(FedDataset):
+    """Shared materialized layout: one .npy of images per natural client
+    (class-split, ref fed_cifar.py:45-58) + a centralized ``test.npz``.
+    Subclasses implement ``_make_xy`` returning the raw arrays; everything
+    else — caching, per-client files, batch fetch — is common (used by
+    CIFAR10/100 and the offline real-data sets)."""
+
+    name = "prepared"
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        if self.train:
+            self.client_datasets = [
+                np.load(self.client_fn(c))
+                for c in range(len(self.images_per_client))]
+        else:
+            with np.load(self.test_fn()) as t:
+                self.test_images = t["test_images"]
+                self.test_targets = t["test_targets"]
+
+    def client_fn(self, client_id: int) -> str:
+        return os.path.join(self.dataset_dir, f"client{client_id}.npy")
+
+    def test_fn(self) -> str:
+        return os.path.join(self.dataset_dir, "test.npz")
+
+    def _make_xy(self):
+        """-> (train_x, train_y, test_x, test_y, num_classes)"""
+        raise NotImplementedError
+
+    def prepare_datasets(self):
+        os.makedirs(self.dataset_dir, exist_ok=True)
+        train_x, train_y, test_x, test_y, n_cls = self._make_xy()
+        images_per_client = []
+        for c in range(n_cls):
+            rows = train_x[train_y == c]
+            images_per_client.append(len(rows))
+            fn = self.client_fn(c)
+            if os.path.exists(fn):
+                raise RuntimeError("won't overwrite existing split")
+            np.save(fn, rows)
+        np.savez(self.test_fn(), test_images=test_x, test_targets=test_y)
+        with open(self.stats_fn(), "w") as f:
+            json.dump({"images_per_client": images_per_client,
+                       "num_val_images": len(test_y)}, f)
+
+    def _get_train_batch(self, client_id: int, idxs: np.ndarray):
+        imgs = self.client_datasets[client_id][idxs]
+        # target == natural client id == the class (ref fed_cifar.py:79-81)
+        return imgs, np.full(len(idxs), client_id, np.int32)
+
+    def _get_val_batch(self, idxs: np.ndarray):
+        return (self.test_images[idxs],
+                self.test_targets[idxs].astype(np.int32))

@@ -26,68 +26,12 @@ artifacts must state exactly which dataset was run (see results.py).
 
 from __future__ import annotations
 
-import json
-import os
-
 import numpy as np
 
-from commefficient_tpu.data.fed_dataset import FedDataset
+from commefficient_tpu.data.fed_dataset import PreparedArrayDataset
 
 
-class _PreparedArrayDataset(FedDataset):
-    """Shared machinery: prepare() materializes class-split client files +
-    a centralized test split, exactly the CIFAR layout (data/cifar.py)."""
-
-    name = "offline"
-
-    def __init__(self, *args, **kw):
-        super().__init__(*args, **kw)
-        if self.train:
-            self.client_datasets = [
-                np.load(self.client_fn(c))
-                for c in range(len(self.images_per_client))]
-        else:
-            with np.load(self.test_fn()) as t:
-                self.test_images = t["test_images"]
-                self.test_targets = t["test_targets"]
-
-    def client_fn(self, client_id: int) -> str:
-        return os.path.join(self.dataset_dir, f"client{client_id}.npy")
-
-    def test_fn(self) -> str:
-        return os.path.join(self.dataset_dir, "test.npz")
-
-    def _make_xy(self):
-        """-> (train_x, train_y, test_x, test_y, num_classes)"""
-        raise NotImplementedError
-
-    def prepare_datasets(self):
-        os.makedirs(self.dataset_dir, exist_ok=True)
-        train_x, train_y, test_x, test_y, n_cls = self._make_xy()
-        images_per_client = []
-        for c in range(n_cls):
-            rows = train_x[train_y == c]
-            images_per_client.append(len(rows))
-            fn = self.client_fn(c)
-            if os.path.exists(fn):
-                raise RuntimeError("won't overwrite existing split")
-            np.save(fn, rows)
-        np.savez(self.test_fn(), test_images=test_x, test_targets=test_y)
-        with open(self.stats_fn(), "w") as f:
-            json.dump({"images_per_client": images_per_client,
-                       "num_val_images": len(test_y)}, f)
-
-    def _get_train_batch(self, client_id: int, idxs: np.ndarray):
-        imgs = self.client_datasets[client_id][idxs]
-        # target == natural client id == the class (ref fed_cifar.py:79-81)
-        return imgs, np.full(len(idxs), client_id, np.int32)
-
-    def _get_val_batch(self, idxs: np.ndarray):
-        return (self.test_images[idxs],
-                self.test_targets[idxs].astype(np.int32))
-
-
-class FedDigits(_PreparedArrayDataset):
+class FedDigits(PreparedArrayDataset):
     """1,797 real 8x8 digit scans; ~150 train + ~30 val per class."""
 
     name = "Digits"
@@ -107,7 +51,7 @@ class FedDigits(_PreparedArrayDataset):
         return x[~val_mask], y[~val_mask], x[val_mask], y[val_mask], 10
 
 
-class FedPatches32(_PreparedArrayDataset):
+class FedPatches32(PreparedArrayDataset):
     """32x32x3 patches of two real photos; 10 (photo, band) classes."""
 
     name = "Patches32"

@@ -28,7 +28,13 @@ class GPT2Config:
     def __init__(self, vocab_size=50262, n_positions=512, n_embd=768,
                  n_layer=12, n_head=12, dropout=0.1, dtype="float32",
                  attn_impl="full", attn_block_size=512, seq_axis="seq",
-                 remat=False):
+                 remat=False, arch="gpt2"):
+        # arch: 'gpt2' (pre-LN blocks + final LN) or 'openai-gpt'
+        # (GPT-1: post-LN blocks, no final LN) — the reference accepts
+        # both checkpoint families (gpt2_train.py:262-273)
+        if arch not in ("gpt2", "openai-gpt"):
+            raise ValueError(f"unknown arch {arch!r}")
+        self.arch = arch
         self.vocab_size = vocab_size
         self.n_positions = n_positions
         self.n_embd = n_embd
@@ -69,6 +75,15 @@ class GPT2Config:
         """For tests and offline byte-tokenizer runs."""
         return cls(vocab_size=vocab_size, n_positions=256, n_embd=128,
                    n_layer=2, n_head=4, dropout=0.0)
+
+    @classmethod
+    def openai_gpt(cls, vocab_size=40478 + 5):
+        """GPT-1 double-heads (ref gpt2_train.py:262-273 'openai-gpt'
+        branch): 12-layer post-LN transformer, 512 positions; default
+        vocab = GPT-1's 40,478 BPE merges + the 5 PersonaChat special
+        tokens the reference adds (gpt2_train.py:101-112)."""
+        return cls(vocab_size=vocab_size, n_positions=512, n_embd=768,
+                   n_layer=12, n_head=12, arch="openai-gpt")
 
 
 class CausalSelfAttention(nn.Module):
@@ -128,29 +143,37 @@ class Block(nn.Module):
     seq_axis: str = "seq"
     moe_experts: int = 0
     moe_capacity_factor: float = 1.25
+    post_ln: bool = False    # GPT-1 places LN after the residual add
+
+    def _mlp(self, h, train: bool):
+        if self.moe_experts > 0:
+            from commefficient_tpu.ops.moe import MoEFFN
+            return MoEFFN(self.moe_experts, 4 * h.shape[-1],
+                          self.moe_capacity_factor, self.dtype,
+                          name="moe")(h)
+        m = nn.Dense(4 * h.shape[-1], dtype=self.dtype,
+                     kernel_init=nn.initializers.normal(0.02))(h)
+        m = nn.gelu(m)
+        return nn.Dense(h.shape[-1], dtype=self.dtype,
+                        kernel_init=nn.initializers.normal(0.02))(m)
 
     @nn.compact
     def __call__(self, x, train: bool):
         # epsilon matches HF GPT-2 (1e-5) so imported pretrained weights
         # reproduce reference logits (models/gpt2_import.py)
-        h = nn.LayerNorm(dtype=self.dtype, epsilon=1e-5)(x)
-        x = x + CausalSelfAttention(self.n_head, self.dropout,
-                                    self.dtype, self.attn_impl,
-                                    self.attn_block_size,
-                                    self.seq_axis)(h, train)
-        h = nn.LayerNorm(dtype=self.dtype, epsilon=1e-5)(x)
-        if self.moe_experts > 0:
-            from commefficient_tpu.ops.moe import MoEFFN
-            m = MoEFFN(self.moe_experts, 4 * x.shape[-1],
-                       self.moe_capacity_factor, self.dtype,
-                       name="moe")(h)
-        else:
-            m = nn.Dense(4 * x.shape[-1], dtype=self.dtype,
-                         kernel_init=nn.initializers.normal(0.02))(h)
-            m = nn.gelu(m)
-            m = nn.Dense(x.shape[-1], dtype=self.dtype,
-                         kernel_init=nn.initializers.normal(0.02))(m)
-        return x + nn.Dropout(self.dropout, deterministic=not train)(m)
+        ln = lambda t: nn.LayerNorm(dtype=self.dtype, epsilon=1e-5)(t)
+        attn = CausalSelfAttention(self.n_head, self.dropout,
+                                   self.dtype, self.attn_impl,
+                                   self.attn_block_size, self.seq_axis)
+        drop = nn.Dropout(self.dropout, deterministic=not train)
+        if self.post_ln:
+            # GPT-1 (ref 'openai-gpt'): LN AFTER each residual add
+            x = ln(x + attn(x, train))
+            return ln(x + drop(self._mlp(x, train)))
+        h = ln(x)
+        x = x + attn(h, train)
+        h = ln(x)
+        return x + drop(self._mlp(h, train))
 
 
 class GPT2DoubleHeads(nn.Module):
@@ -182,12 +205,15 @@ class GPT2DoubleHeads(nn.Module):
         # static_argnums counts the flax scope as arg 0: train is arg 2
         block_cls = (nn.remat(Block, static_argnums=(2,))
                      if cfg.remat else Block)
+        post_ln = cfg.arch == "openai-gpt"
         for _ in range(cfg.n_layer):
             x = block_cls(cfg.n_head, cfg.dropout, cfg.jnp_dtype,
                           cfg.attn_impl, cfg.attn_block_size,
                           cfg.seq_axis, cfg.moe_experts,
-                          cfg.moe_capacity_factor)(x, train)
-        x = nn.LayerNorm(epsilon=1e-5)(x.astype(jnp.float32))
+                          cfg.moe_capacity_factor, post_ln)(x, train)
+        x = x.astype(jnp.float32)
+        if not post_ln:
+            x = nn.LayerNorm(epsilon=1e-5)(x)   # GPT-1 has no final LN
 
         # LM head tied to wte (GPT-2 weight tying); logits in f32
         lm_logits = wte.attend(x)

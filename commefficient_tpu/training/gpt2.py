@@ -67,9 +67,14 @@ def train(args, mesh=None, max_rounds=None, log=True):
     from commefficient_tpu.parallel.mesh import padded_num_clients
     num_clients = padded_num_clients(args.num_clients, mesh)
 
-    gcfg = (GPT2Config.small(vocab_size=tokenizer.vocab_size)
-            if args.model == "gpt2" else
-            GPT2Config.tiny(vocab_size=tokenizer.vocab_size))
+    if args.model == "gpt2":
+        gcfg = GPT2Config.small(vocab_size=tokenizer.vocab_size)
+    elif args.model == "openai-gpt":
+        # GPT-1 double-heads (ref gpt2_train.py:262-273 accepts both
+        # checkpoint families); post-LN arch, vocab from the tokenizer
+        gcfg = GPT2Config.openai_gpt(vocab_size=tokenizer.vocab_size)
+    else:
+        gcfg = GPT2Config.tiny(vocab_size=tokenizer.vocab_size)
     gcfg.n_positions = max(gcfg.n_positions, args.max_seq_len)
     # 'blockwise' = flash-style O(T*block) attention for long sequences
     # (ops/attention.py); 'full' matches the reference's materialized scores
@@ -246,7 +251,8 @@ def main(argv=None):
                              "for long sequences")
     for a in parser._actions:  # NLP model/dataset names join the CV choices
         if a.dest == "model":
-            a.choices = sorted(set(a.choices) | {"gpt2", "gpt2-tiny"})
+            a.choices = sorted(set(a.choices) |
+                               {"gpt2", "gpt2-tiny", "openai-gpt"})
         if a.dest == "dataset_name":
             a.choices = sorted(set(a.choices) | {"SyntheticPersona"})
     parser.set_defaults(dataset_name="SyntheticPersona", model="gpt2-tiny",

@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import time
 
 import numpy as np
@@ -95,12 +96,18 @@ def task_flags(task: str, quick: bool) -> list:
 def run_one(task: str, mode: str, quick: bool) -> dict:
     from commefficient_tpu.training.cv import build_parser, train
     argv = task_flags(task, quick) + mode_flags(mode, task, quick)
-    if mode == "fedavg":
-        # whole-client batches (utils.py:225-228) + a gentler LR: fedavg
-        # applies it worker-side over full local epochs
-        argv = [a for a in argv]
+    # per-mode LR: fedavg applies lr worker-side over whole-client local
+    # epochs; local_topk's local momentum (0.9) + error feedback compound
+    # the effective step ~1/(1-m)x (measured: NaN at the base LR's ramp)
+    lr_override = {
+        ("patches32", "fedavg"): "0.05",
+        ("patches32", "local_topk"): "0.02",
+        ("digits", "fedavg"): "0.05",
+        ("digits", "local_topk"): "0.05",
+    }.get((task, mode))
+    if lr_override is not None:
         i = argv.index("--lr_scale")
-        argv[i + 1] = "0.05" if task == "patches32" else "0.05"
+        argv[i + 1] = lr_override
     args = build_parser().parse_args(argv)
     np.random.seed(args.seed)
     t0 = time.time()
@@ -184,8 +191,15 @@ def main():
     ap.add_argument("--modes", default=",".join(MODES))
     ap.add_argument("--quick", action="store_true",
                     help="8 rounds per mode — plumbing smoke, not results")
-    ap.add_argument("--out", default="RESULTS")
+    ap.add_argument("--out", default=None,
+                    help="artifact basename (default RESULTS, or "
+                         "RESULTS_smoke under --quick so a smoke run can "
+                         "never clobber or leak into the real artifact)")
     args = ap.parse_args()
+    if args.out is None:
+        args.out = "RESULTS_smoke" if args.quick else "RESULTS"
+    elif args.quick and args.out == "RESULTS":
+        raise SystemExit("--quick may not write the real RESULTS artifact")
 
     tasks = ["patches32", "digits"] if args.task == "both" else [args.task]
     modes = [m.strip() for m in args.modes.split(",") if m.strip()]
@@ -193,7 +207,14 @@ def main():
     if bad:
         raise SystemExit(f"unknown modes: {sorted(bad)}")
 
+    # incremental: merge into an existing artifact so one (task, mode) can
+    # be rerun (e.g. after an LR adjustment) without repeating the suite
     results = []
+    if os.path.exists(args.out + ".json") and not args.quick:
+        with open(args.out + ".json") as f:
+            results = [r for r in json.load(f)["results"]
+                       if not (r["task"] in tasks and r["mode"] in modes)]
+
     for task in tasks:
         for mode in modes:
             results.append(run_one(task, mode, args.quick))
@@ -201,6 +222,11 @@ def main():
                 json.dump({"quick": args.quick, "results": results}, f,
                           indent=1)
     if not args.quick:
+        order = {(t, m): (ti, mi) for ti, t in
+                 enumerate(("patches32", "digits"))
+                 for mi, m in enumerate(MODES)}
+        results.sort(key=lambda r: order.get((r["task"], r["mode"]),
+                                             (9, 9)))
         write_markdown(results, args.out + ".md")
     print(f"wrote {args.out}.json" + ("" if args.quick
                                       else f" and {args.out}.md"))

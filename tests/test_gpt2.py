@@ -216,3 +216,36 @@ def test_gpt2_entrypoint_learns(tmp_path):
     learner, row = train(args, log=False)
     assert np.isfinite(row["train_loss"])
     assert row["ppl"] < 40  # byte-vocab word soup: far below uniform (~261)
+
+
+def test_openai_gpt_arch():
+    # GPT-1 variant (ref gpt2_train.py:262-273 'openai-gpt'): post-LN
+    # blocks, no final LayerNorm, same double-heads contract
+    cfg = GPT2Config.tiny()
+    cfg.arch = "openai-gpt"
+    model = GPT2DoubleHeads(cfg)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 300, (2, 2, 16)).astype(np.int32)
+    types = rng.randint(0, 3, (2, 2, 16)).astype(np.int32)
+    mc = np.full((2, 2), 15, np.int32)
+    variables = model.init(jax.random.PRNGKey(0), ids, types, mc,
+                           train=False)
+    lm, mcl = model.apply(variables, ids, types, mc, train=False)
+    assert lm.shape == (2, 2, 16, 300) and mcl.shape == (2, 2)
+    assert np.isfinite(np.asarray(lm)).all()
+    # structural proof of post-LN: the trunk has NO top-level final
+    # LayerNorm param (GPT-2 does), and each block carries its two LNs
+    params = variables["params"]
+    assert not any(k.startswith("LayerNorm") for k in params)
+    g2 = GPT2DoubleHeads(GPT2Config.tiny())
+    p2 = g2.init(jax.random.PRNGKey(0), ids, types, mc,
+                 train=False)["params"]
+    assert any(k.startswith("LayerNorm") for k in p2)
+
+
+def test_openai_gpt_cli_smoke(tmp_path):
+    from commefficient_tpu.training.gpt2 import main
+    rc = main(["--test", "--model", "openai-gpt",
+               "--dataset_name", "SyntheticPersona",
+               "--dataset_dir", str(tmp_path), "--max_seq_len", "32"])
+    assert rc == 0

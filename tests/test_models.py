@@ -130,3 +130,38 @@ def test_emnist_single_channel_stem():
 def test_unknown_model_raises():
     with pytest.raises(ValueError, match="unknown model"):
         get_model("ResNet9000")
+
+
+def test_resnet9_bf16_converges_like_f32():
+    # the bench's headline CIFAR metric now runs dtype="bfloat16"
+    # (bench.py): convs/matmuls in bf16, params/logits f32. Convergence
+    # must be preserved — train the same tiny problem both ways.
+    from commefficient_tpu.config import FedConfig
+    from commefficient_tpu.federated.api import FedLearner
+    from commefficient_tpu.federated.losses import make_cv_loss
+    from commefficient_tpu.models import ResNet9
+
+    rng = np.random.RandomState(0)
+    W, B = 2, 8
+    tmpl = rng.randn(2, 32, 32, 3).astype(np.float32)
+    ys = rng.randint(0, 2, (W, B)).astype(np.int32)
+    Xs = tmpl[ys] + 0.3 * rng.randn(W, B, 32, 32, 3).astype(np.float32)
+    mask = np.ones((W, B), np.float32)
+
+    def run(dtype):
+        model = ResNet9(num_classes=2, dtype=dtype)
+        cfg = FedConfig(mode="uncompressed", error_type="none",
+                        virtual_momentum=0.9, weight_decay=0,
+                        num_workers=W, num_clients=W, lr_scale=0.05)
+        ln = FedLearner(model, cfg, make_cv_loss(model), None,
+                        jax.random.PRNGKey(0), Xs[0][:1])
+        first = ln.train_round(np.arange(W), (Xs, ys), mask)
+        for _ in range(24):
+            last = ln.train_round(np.arange(W), (Xs, ys), mask)
+        return first["loss"], last["loss"], last["metrics"][0]
+
+    f0, f1, facc = run("float32")
+    b0, b1, bacc = run("bfloat16")
+    assert b1 < b0 * 0.5, (b0, b1)          # bf16 really learns
+    assert abs(b0 - f0) < 0.1 * max(f0, 1e-3)  # same starting loss
+    assert bacc >= facc - 0.15              # accuracy parity (tolerant)
