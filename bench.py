@@ -224,7 +224,9 @@ def bench_longcontext_tokens():
 
     from commefficient_tpu.models.gpt2 import GPT2Config, GPT2DoubleHeads
 
-    B, T = 1, 4096
+    # B=4 measured +37% tokens/s over B=1 (48.8k vs 35.6k same-session)
+    # and still fits HBM with remat + the flash kernel; B=8 saturates
+    B, T = 4, 4096
     gcfg = GPT2Config.small(vocab_size=50262)
     gcfg.n_positions = T
     gcfg.dropout = 0.0
