@@ -93,7 +93,20 @@ def task_flags(task: str, quick: bool) -> list:
             "--weight_decay", "1e-4", "--seed", "21"]
 
 
-def run_one(task: str, mode: str, quick: bool) -> dict:
+SWEEP = [
+    # the paper's actual deliverable is a CURVE: accuracy at several byte
+    # budgets per mode. Variants override the compression size flags on
+    # the patches32 recipe; labels name the upload budget per client/round.
+    ("sketch", "sketch_5x200k_k20k",
+     ["--num_rows", "5", "--num_cols", "200000", "--k", "20000"]),
+    ("sketch", "sketch_5x100k_k10k",
+     ["--num_rows", "5", "--num_cols", "100000", "--k", "10000"]),
+    ("true_topk", "true_topk_k10k", ["--k", "10000"]),
+    ("local_topk", "local_topk_k200k", ["--k", "200000"]),
+]
+
+
+def run_one(task: str, mode: str, quick: bool, variant=None) -> dict:
     from commefficient_tpu.training.cv import build_parser, train
     argv = task_flags(task, quick) + mode_flags(mode, task, quick)
     # per-mode LR: fedavg applies lr worker-side over whole-client local
@@ -108,6 +121,10 @@ def run_one(task: str, mode: str, quick: bool) -> dict:
     if lr_override is not None:
         i = argv.index("--lr_scale")
         argv[i + 1] = lr_override
+    label = mode
+    if variant is not None:
+        label, extra = variant
+        argv = argv + extra
     args = build_parser().parse_args(argv)
     np.random.seed(args.seed)
     t0 = time.time()
@@ -117,7 +134,7 @@ def run_one(task: str, mode: str, quick: bool) -> dict:
     d = learner.cfg.grad_size
     up_per_client_round = 4.0 * learner.cfg.upload_floats_per_client
     out = {
-        "task": task, "mode": mode, "aborted": aborted,
+        "task": task, "mode": label, "aborted": aborted,
         "grad_size": d,
         "final_test_acc": None if aborted else float(row["test_acc"]),
         "final_train_loss": None if aborted else float(row["train_loss"]),
@@ -128,7 +145,7 @@ def run_one(task: str, mode: str, quick: bool) -> dict:
         "upload_bytes_per_client_round": up_per_client_round,
         "wall_seconds": round(wall, 1),
     }
-    print(f"[{task}/{mode}] acc={out['final_test_acc']} "
+    print(f"[{task}/{label}] acc={out['final_test_acc']} "
           f"up={out['upload_bytes_total']/2**20:.1f}MiB "
           f"down={out['download_bytes_total']/2**20:.1f}MiB "
           f"rounds={out['rounds']} ({wall:.0f}s)", flush=True)
@@ -171,12 +188,13 @@ def write_markdown(results: list, path: str = "RESULTS.md") -> None:
                              f"{r['rounds']} | {r['wall_seconds']}s |")
                 continue
             upx = (base["upload_bytes_total"] / r["upload_bytes_total"]
-                   if base and r["upload_bytes_total"] else float("nan"))
+                   if base and r["upload_bytes_total"] else None)
+            up_cell = f"{upx:.1f}x less" if upx is not None else "—"
             lines.append(
                 f"| {r['mode']} | {r['final_test_acc']:.4f} | "
                 f"{r['upload_bytes_per_client_round']/2**20:.2f} MiB | "
                 f"{r['upload_bytes_total']/2**30:.2f} GiB | "
-                f"{upx:.1f}x less | "
+                f"{up_cell} | "
                 f"{r['download_bytes_total']/2**30:.2f} GiB | "
                 f"{r['rounds']} | {r['wall_seconds']:.0f}s |")
         lines.append("")
@@ -191,6 +209,9 @@ def main():
     ap.add_argument("--modes", default=",".join(MODES))
     ap.add_argument("--quick", action="store_true",
                     help="8 rounds per mode — plumbing smoke, not results")
+    ap.add_argument("--sweep", action="store_true",
+                    help="run the byte-budget sweep variants (SWEEP) on "
+                         "patches32 instead of the base modes")
     ap.add_argument("--out", default=None,
                     help="artifact basename (default RESULTS, or "
                          "RESULTS_smoke under --quick so a smoke run can "
@@ -207,27 +228,43 @@ def main():
     if bad:
         raise SystemExit(f"unknown modes: {sorted(bad)}")
 
+    jobs = [(t, m, None) for t in tasks for m in modes]
+    if args.sweep:
+        if args.task != "both" or args.modes != ",".join(MODES):
+            raise SystemExit("--sweep runs its own fixed job list; "
+                             "--task/--modes would be silently ignored")
+        if args.quick:
+            raise SystemExit("--sweep is a real-budget curve; it has no "
+                             "quick mode (variant sizes would override "
+                             "the smoke sizes)")
+        jobs = [("patches32", mode, (label, extra))
+                for mode, label, extra in SWEEP]
+
     # incremental: merge into an existing artifact so one (task, mode) can
     # be rerun (e.g. after an LR adjustment) without repeating the suite
     results = []
+    labels = {(t, v[0] if v else m) for t, m, v in jobs}
     if os.path.exists(args.out + ".json") and not args.quick:
         with open(args.out + ".json") as f:
             results = [r for r in json.load(f)["results"]
-                       if not (r["task"] in tasks and r["mode"] in modes)]
+                       if (r["task"], r["mode"]) not in labels]
 
-    for task in tasks:
-        for mode in modes:
-            results.append(run_one(task, mode, args.quick))
-            with open(args.out + ".json", "w") as f:
-                json.dump({"quick": args.quick, "results": results}, f,
-                          indent=1)
-    if not args.quick:
-        order = {(t, m): (ti, mi) for ti, t in
-                 enumerate(("patches32", "digits"))
-                 for mi, m in enumerate(MODES)}
-        results.sort(key=lambda r: order.get((r["task"], r["mode"]),
-                                             (9, 9)))
-        write_markdown(results, args.out + ".md")
+    order = {(t, m): (ti, mi) for ti, t in
+             enumerate(("patches32", "digits"))
+             for mi, m in enumerate(MODES)}
+    sort_key = lambda r: (*order.get((r["task"], r["mode"]),  # noqa: E731
+                                     (0 if r["task"] == "patches32"
+                                      else 1, 9)), r["mode"])
+    for task, mode, variant in jobs:
+        results.append(run_one(task, mode, args.quick, variant=variant))
+        results.sort(key=sort_key)
+        # JSON and markdown regenerate together after EVERY job, so an
+        # interrupted run never leaves the artifact pair inconsistent
+        with open(args.out + ".json", "w") as f:
+            json.dump({"quick": args.quick, "results": results}, f,
+                      indent=1)
+        if not args.quick:
+            write_markdown(results, args.out + ".md")
     print(f"wrote {args.out}.json" + ("" if args.quick
                                       else f" and {args.out}.md"))
 

@@ -488,3 +488,61 @@ def test_nan_guard_healthy_path_untouched():
     assert weight(ln) == pytest.approx(0.14, abs=1e-6)
     assert not bool(ln.state.aborted)
     assert int(ln.state.round_idx) == 1
+
+
+@pytest.mark.parametrize("cfg_kw", [
+    dict(mode="uncompressed", error_type="none", virtual_momentum=0.9),
+    dict(mode="true_topk", error_type="virtual", k=3, virtual_momentum=0.9),
+    dict(mode="sketch", error_type="virtual", k=3, num_rows=3,
+         num_cols=50, virtual_momentum=0.9),
+])
+def test_fused_path_matches_per_worker_vmap(cfg_kw):
+    # the fused-gradient fast path (one backward over the whole W*B batch)
+    # must reproduce the per-worker vmap formulation exactly (linearity:
+    # sum of per-client grads == grad of summed loss), including weight
+    # decay scaling and padded-worker masking
+    from commefficient_tpu.federated.round import (build_round_step,
+                                                   init_fed_state)
+    from commefficient_tpu.federated.losses import make_cv_loss
+    from commefficient_tpu.models import TinyMLP
+    from commefficient_tpu.utils.params import flatten_params
+
+    model = TinyMLP(num_classes=2, hidden=6)
+    rng = np.random.RandomState(0)
+    W, B = 3, 5
+    Xs = rng.randn(W, B, 4).astype(np.float32)
+    ys = (Xs[:, :, 0] > 0).astype(np.int32)
+    mask = np.ones((W, B), np.float32)
+    mask[2, 3:] = 0.0          # ragged tail
+    mask[1, :] = 0.0           # fully padded worker slot
+    ids = np.array([0, 0, 2])  # padded slot aliases id 0
+
+    params = model.init(jax.random.PRNGKey(3), Xs[0][:1],
+                        train=False)["params"]
+    flat, unflatten = flatten_params(params)
+    flat = np.asarray(flat)  # host copy: the round donates its state
+    cfg = FedConfig(num_workers=W, num_clients=4, lr_scale=0.1,
+                    weight_decay=5e-4, **cfg_kw).finalize(flat.shape[0])
+    loss = make_cv_loss(model)
+
+    def run(force):
+        step = build_round_step(loss, unflatten, cfg,
+                                force_per_worker=force)
+        state = init_fed_state(cfg, jnp.asarray(flat))
+        outs = []
+        for r in range(3):
+            state, m = step(state, jnp.asarray(ids),
+                            (jnp.asarray(Xs), jnp.asarray(ys)),
+                            jnp.asarray(mask), 0.1,
+                            jax.random.PRNGKey(7))
+            outs.append(jax.device_get(m))
+        return np.asarray(state.weights), outs
+
+    w_fused, m_fused = run(False)
+    w_slow, m_slow = run(True)
+    np.testing.assert_allclose(w_fused, w_slow, rtol=1e-5, atol=1e-7)
+    for a, b in zip(m_fused, m_slow):
+        np.testing.assert_allclose(a["loss_sum"], b["loss_sum"], rtol=1e-5)
+        assert a["num_datapoints"] == b["num_datapoints"]
+        assert a["upload_bytes"] == b["upload_bytes"]
+        assert a["download_bytes"] == b["download_bytes"]
