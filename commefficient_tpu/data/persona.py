@@ -127,6 +127,7 @@ class FedPERSONA(FedDataset):
             "max_history": max_history,
             "max_seq_len": max_seq_len,
             "personality_permutations": personality_permutations,
+            **self._extra_cache_meta(),
         }
         meta_fn = os.path.join(dataset_dir, "cache_meta.json")
         if os.path.exists(meta_fn):
@@ -149,6 +150,11 @@ class FedPERSONA(FedDataset):
 
     def _cache_fn(self, split):
         return os.path.join(self.dataset_dir, f"{split}_cache.npz")
+
+    def _extra_cache_meta(self) -> dict:
+        """Subclass hook: extra settings the cache depends on (e.g.
+        SyntheticPersona's generation size)."""
+        return {}
 
     def raw_fn(self):
         return os.path.join(self.dataset_dir,
@@ -245,6 +251,12 @@ class SyntheticPersona(FedPERSONA):
         self.utterances_per_dialog = utterances_per_dialog
         self.gen_seed = gen_seed
         super().__init__(dataset_dir=dataset_dir, **kw)
+
+    def _extra_cache_meta(self) -> dict:
+        return {"num_clients_gen": self.num_clients_gen,
+                "dialogs_per_client": self.dialogs_per_client,
+                "utterances_per_dialog": self.utterances_per_dialog,
+                "gen_seed": self.gen_seed}
 
     def _raw_dialogs(self):
         rng = np.random.RandomState(self.gen_seed)

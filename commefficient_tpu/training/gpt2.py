@@ -56,6 +56,8 @@ def make_persona(args, tokenizer, train: bool):
               dataset_dir=args.dataset_dir, seed=args.seed)
     if args.dataset_name == "PERSONA":
         return FedPERSONA(**kw)
+    kw.update(num_clients_gen=getattr(args, "synthetic_personas", 8),
+              dialogs_per_client=getattr(args, "synthetic_dialogs", 4))
     return SyntheticPersona(**kw)
 
 
@@ -242,13 +244,20 @@ def _print_sample(args, model, learner, tokenizer, val_set):
         print(f"generation sample skipped ({type(e).__name__}: {e})")
 
 
-def main(argv=None):
+def build_gpt2_parser():
+    """The NLP flag surface: CV parser + GPT2 extras (also used by the
+    results harness to drive full persona runs)."""
     parser = build_parser(default_lr=4e-2)  # ref gpt2_train.py:256
     parser.add_argument("--max_seq_len", type=int, default=256)
     parser.add_argument("--attn_impl", choices=("full", "blockwise"),
                         default="full",
                         help="blockwise = flash-style O(T*block) memory "
                              "for long sequences")
+    parser.add_argument("--synthetic_personas", type=int, default=8,
+                        help="SyntheticPersona: number of generated "
+                             "personas (= natural clients)")
+    parser.add_argument("--synthetic_dialogs", type=int, default=4,
+                        help="SyntheticPersona: dialogs per persona")
     for a in parser._actions:  # NLP model/dataset names join the CV choices
         if a.dest == "model":
             a.choices = sorted(set(a.choices) |
@@ -258,6 +267,11 @@ def main(argv=None):
     parser.set_defaults(dataset_name="SyntheticPersona", model="gpt2-tiny",
                         local_batch_size=4, valid_batch_size=4,
                         num_workers=2)
+    return parser
+
+
+def main(argv=None):
+    parser = build_gpt2_parser()
     args = parser.parse_args(argv)
     if args.do_test:
         args.num_epochs = 1
