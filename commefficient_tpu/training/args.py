@@ -34,6 +34,9 @@ def build_parser(default_lr: float = 0.4) -> argparse.ArgumentParser:
     p.add_argument("--dataset_dir", default="./dataset")
     p.add_argument("--batchnorm", action="store_true", dest="do_batchnorm")
     p.add_argument("--nan_threshold", type=float, default=999)
+    p.add_argument("--eval_before_start", action="store_true",
+                   help="run a validation pass before training "
+                        "(ref cv_train.py:91)")
     p.add_argument("--checkpoint", action="store_true", dest="do_checkpoint")
     p.add_argument("--checkpoint_path", default="./checkpoint")
     p.add_argument("--finetune", action="store_true", dest="do_finetune")

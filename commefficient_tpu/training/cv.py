@@ -117,6 +117,19 @@ def train(args, mesh=None, max_rounds=None, log=True):
     timer = Timer()
     spe = batcher.steps_per_epoch()
     total_rounds = 0
+    if getattr(args, "eval_before_start", False):
+        # baseline validation at init (ref cv_train.py:91-103). Snapshot
+        # the learner rng: evaluate() splits the shared stream, and a
+        # logging-only flag must not perturb the training trajectory
+        rng_before = learner.rng
+        val0 = learner.evaluate(val_batches(val_set, args.valid_batch_size))
+        learner.rng = rng_before
+        if log:
+            print(f"eval before start: loss={val0['loss']:.4f} "
+                  f"acc={float(val0['metrics'][0]):.4f}")
+        if writer:
+            writer.add_scalar("test_loss", val0["loss"], 0)
+            writer.add_scalar("test_acc", float(val0["metrics"][0]), 0)
     try:
         for epoch in range(int(math.ceil(args.num_epochs))):
             epoch_metrics = []

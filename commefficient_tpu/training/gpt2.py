@@ -144,6 +144,22 @@ def train(args, mesh=None, max_rounds=None, log=True):
     timer = Timer()
     total_rounds = 0
     row = {}
+    if getattr(args, "eval_before_start", False):
+        # baseline validation at init (ref cv_train.py:91-103); rng
+        # snapshot keeps the training trajectory flag-independent
+        rng_before = learner.rng
+        val0 = learner.evaluate(val_batches(val_set, args.valid_batch_size))
+        learner.rng = rng_before
+        if np.size(val0["metrics"]) >= 3:
+            nll0 = (float(val0["metrics"][1]) /
+                    max(float(val0["metrics"][2]), 1e-9))
+        else:
+            nll0 = float(val0["loss"])
+        if log:
+            print(f"eval before start: nll={nll0:.4f} "
+                  f"ppl={float(np.exp(min(nll0, 20.0))):.2f}")
+        if writer:
+            writer.add_scalar("nll", nll0, 0)
     try:
         for epoch in range(int(math.ceil(args.num_epochs))):
             losses = []

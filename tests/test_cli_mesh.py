@@ -87,3 +87,45 @@ def test_parse_mesh_rejects_nonpositive():
         parse_mesh("clients=-2")
     with pytest.raises(ValueError, match="seq must be positive"):
         parse_mesh("clients=4,seq=0")
+
+
+def test_eval_before_start(tmp_path, capsys):
+    # ref cv_train.py:91: a validation pass before any training round
+    from commefficient_tpu.training.cv import main
+    rc = main(["--test", "--eval_before_start",
+               "--dataset_name", "Synthetic",
+               "--dataset_dir", str(tmp_path)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "eval before start:" in out
+
+
+def test_eval_before_start_does_not_change_trajectory(tmp_path):
+    # the flag is logging-only: the rng snapshot must keep training
+    # identical with and without it
+    from commefficient_tpu.training.args import build_parser
+    from commefficient_tpu.training.cv import train
+
+    def run(extra):
+        args = build_parser().parse_args(
+            ["--mode", "uncompressed", "--error_type", "none",
+             "--virtual_momentum", "0.9", "--num_workers", "2",
+             "--local_batch_size", "8", "--dataset_name", "Synthetic",
+             "--dataset_dir", str(tmp_path), "--num_epochs", "1",
+             "--model", "TinyMLP"] + extra)
+        np.random.seed(args.seed)
+        learner, row = train(args, max_rounds=2, log=False)
+        return np.asarray(learner.state.weights)
+
+    w_plain = run([])
+    w_eval = run(["--eval_before_start"])
+    np.testing.assert_array_equal(w_plain, w_eval)
+
+
+def test_gpt2_eval_before_start(tmp_path, capsys):
+    from commefficient_tpu.training.gpt2 import main
+    rc = main(["--test", "--eval_before_start",
+               "--dataset_name", "SyntheticPersona",
+               "--dataset_dir", str(tmp_path), "--max_seq_len", "32"])
+    assert rc == 0
+    assert "eval before start: nll=" in capsys.readouterr().out
