@@ -145,6 +145,10 @@ class PreparedArrayDataset(FedDataset):
     CIFAR10/100 and the offline real-data sets)."""
 
     name = "prepared"
+    #: bump in a subclass whenever its ``_make_xy`` changes what it returns;
+    #: a cached split written by an older version is deleted and rebuilt
+    #: (caches without the key are grandfathered as version 1)
+    version = 1
 
     def __init__(self, *args, **kw):
         super().__init__(*args, **kw)
@@ -167,21 +171,38 @@ class PreparedArrayDataset(FedDataset):
         """-> (train_x, train_y, test_x, test_y, num_classes)"""
         raise NotImplementedError
 
+    def _load_meta(self):
+        with open(self.stats_fn()) as f:
+            stats = json.load(f)
+        if stats.get("version", 1) != self.version:
+            # stale cache from an older _make_xy (e.g. the pre-round-4
+            # leaky Patches32 split): drop and rebuild deterministically
+            for c in range(len(stats["images_per_client"])):
+                if os.path.exists(self.client_fn(c)):
+                    os.remove(self.client_fn(c))
+            for fn in (self.test_fn(), self.stats_fn()):
+                if os.path.exists(fn):
+                    os.remove(fn)
+            self.prepare_datasets()
+        super()._load_meta()
+
     def prepare_datasets(self):
         os.makedirs(self.dataset_dir, exist_ok=True)
         train_x, train_y, test_x, test_y, n_cls = self._make_xy()
         images_per_client = []
+        # overwriting is allowed: stats.json is written LAST and is the
+        # cache-validity marker, so an interrupted build (partial client
+        # files, no stats.json) is simply rebuilt on the next construction
+        # instead of wedging the dir (review r4)
         for c in range(n_cls):
             rows = train_x[train_y == c]
             images_per_client.append(len(rows))
-            fn = self.client_fn(c)
-            if os.path.exists(fn):
-                raise RuntimeError("won't overwrite existing split")
-            np.save(fn, rows)
+            np.save(self.client_fn(c), rows)
         np.savez(self.test_fn(), test_images=test_x, test_targets=test_y)
         with open(self.stats_fn(), "w") as f:
             json.dump({"images_per_client": images_per_client,
-                       "num_val_images": len(test_y)}, f)
+                       "num_val_images": len(test_y),
+                       "version": self.version}, f)
 
     def _get_train_batch(self, client_id: int, idxs: np.ndarray):
         imgs = self.client_datasets[client_id][idxs]

@@ -52,17 +52,42 @@ class FedDigits(PreparedArrayDataset):
 
 
 class FedPatches32(PreparedArrayDataset):
-    """32x32x3 patches of two real photos; 10 (photo, band) classes."""
+    """32x32x3 patches of two real photos; 10 (photo, band) classes.
+
+    Train/val are SPATIALLY DISJOINT: validation patches come from a
+    held-out right-hand column strip (``x0 >= VAL_X0``) of each photo,
+    training patches end at least ``GAP`` (=32) pixels before that strip
+    starts, and the patches in between are discarded — so no validation
+    pixel appears in any training patch.  (Patches still overlap *within*
+    a split because of the stride-8 cut; within-split overlap shrinks the
+    effective sample count but cannot leak train pixels into val.)
+    Rounds <=3 used an interleaved every-7th split whose val patches
+    shared up to 75% of their pixels with train patches, so those
+    accuracies partly measured memorization (ADVICE r3, medium); all
+    RESULTS artifacts were regenerated with this split.
+    """
 
     name = "Patches32"
     num_classes = 10
     stride = 8
     bands = 5
+    version = 2    # v1 = the leaky interleaved split; stale caches rebuild
+    VAL_X0 = 496   # val strip starts here (patch x-extent 496..639)
+    GAP = 32       # train patches must end >= GAP px before VAL_X0
+
+    @classmethod
+    def _split_for_x0(cls, x0: int, P: int = 32):
+        """'val' | 'train' | None (guard band) for a patch at column x0."""
+        if x0 >= cls.VAL_X0:
+            return "val"
+        if x0 + P <= cls.VAL_X0 - cls.GAP:
+            return "train"
+        return None
 
     def _make_xy(self):
         from sklearn.datasets import load_sample_images
         photos = load_sample_images().images  # [(427, 640, 3) uint8] x 2
-        xs, ys = [], []
+        xs, ys, in_val = [], [], []
         P, S = 32, self.stride
         for img_idx, img in enumerate(photos):
             H, W, _ = img.shape
@@ -71,20 +96,19 @@ class FedPatches32(PreparedArrayDataset):
                 band = min(int(y0 / band_h), self.bands - 1)
                 label = img_idx * self.bands + band
                 for x0 in range(0, W - P + 1, S):
+                    split = self._split_for_x0(x0, P)
+                    if split is None:
+                        continue              # guard band: discarded
                     xs.append(img[y0:y0 + P, x0:x0 + P])
                     ys.append(label)
+                    in_val.append(split == "val")
         x = np.asarray(xs, np.float32) / 255.0
-        # standardize per channel with the corpus's own statistics (the
+        y = np.asarray(ys, np.int32)
+        val_mask = np.asarray(in_val, bool)
+        # standardize per channel with TRAIN-split statistics only (the
         # CIFAR pipelines normalize with dataset constants the same way,
         # data/transforms.py) — deterministic: derived from fixed pixels
-        mean = x.mean(axis=(0, 1, 2), keepdims=True)
-        std = x.std(axis=(0, 1, 2), keepdims=True)
+        mean = x[~val_mask].mean(axis=(0, 1, 2), keepdims=True)
+        std = x[~val_mask].std(axis=(0, 1, 2), keepdims=True)
         x = (x - mean) / np.maximum(std, 1e-6)
-        y = np.asarray(ys, np.int32)
-        # deterministic interleaved split: every 7th patch (of each class,
-        # in raster order) validates — identical split for every mode
-        val_mask = np.zeros(len(y), bool)
-        for c in range(10):
-            rows = np.nonzero(y == c)[0]
-            val_mask[rows[::7]] = True
         return x[~val_mask], y[~val_mask], x[val_mask], y[val_mask], 10

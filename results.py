@@ -14,8 +14,11 @@ accuracy target is re-measured on the closest real-pixel proxies
 available offline, see data/offline.py):
 
 * **patches32** (headline): FedPatches32 — 32x32x3 patches of scikit-learn's
-  two bundled real photographs, 10 balanced (photo, band) classes, 6,600
-  train / 1,100 val. ResNet9 at its full CIFAR size (d = 6,568,640), 100
+  two bundled real photographs, 10 balanced (photo, band) classes, 5,500
+  train / 1,500 val. The splits are SPATIALLY DISJOINT (val = a held-out
+  column strip with a 32px guard band, data/offline.py) — round-3 numbers
+  used an interleaved split with 75% train/val pixel overlap and are not
+  comparable. ResNet9 at its full CIFAR size (d = 6,568,640), 100
   clients non-iid (class-per-client, the reference's CIFAR recipe,
   fed_cifar.py:45-58), 10 clients sampled per round, the reference's LR
   recipe (PiecewiseLinear 0 -> 0.4 @ epoch 5 -> 0 @ epoch 24,

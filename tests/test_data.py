@@ -335,6 +335,41 @@ def test_offline_patches_dataset(tmp_path):
     # 10 balanced (photo, band) classes
     assert len(p.images_per_client) == 10
     assert len(set(p.images_per_client.tolist())) == 1
+    # ADVICE r3 (medium): train/val must be spatially disjoint with a
+    # >=32px pixel gap — exhaustively check the actual split rule over
+    # every cut position
+    P, S, H, W = 32, FedPatches32.stride, 427, 640
+    splits = {x0: FedPatches32._split_for_x0(x0, P)
+              for x0 in range(0, W - P + 1, S)}
+    train_x0 = [x for x, s in splits.items() if s == "train"]
+    val_x0 = [x for x, s in splits.items() if s == "val"]
+    assert train_x0 and val_x0
+    # no train pixel column reaches within GAP of any val pixel column
+    assert max(x + P for x in train_x0) + FedPatches32.GAP <= min(val_x0)
+    pv = FedPatches32(dataset_dir=str(tmp_path / "pt"), num_clients=10,
+                      train=False, seed=0)
+    rows_per_image = len(range(0, H - P + 1, S))
+    assert pv.num_val_images == len(val_x0) * rows_per_image * 2  # 2 photos
+    assert len(p) == len(train_x0) * rows_per_image * 2
+
+
+def test_prepared_dataset_stale_cache_rebuilds(tmp_path):
+    # a cache written by an older _make_xy (different `version`) must be
+    # rebuilt, not silently served (review r4: the round-3 leaky-split
+    # cache would otherwise survive the split fix)
+    import json
+    from commefficient_tpu.data import FedPatches32
+    d = str(tmp_path / "pt")
+    FedPatches32(dataset_dir=d, num_clients=10, train=True, seed=0)
+    stats_fn = tmp_path / "pt" / "stats.json"
+    stats = json.loads(stats_fn.read_text())
+    assert stats["version"] == FedPatches32.version
+    # forge an old-version cache with a wrong split
+    stats["version"] = 1
+    stats["num_val_images"] = 7
+    stats_fn.write_text(json.dumps(stats))
+    p2 = FedPatches32(dataset_dir=d, num_clients=10, train=False, seed=0)
+    assert p2.num_val_images == 1500  # rebuilt, not the forged 7
 
 
 def test_synthetic_persona_cache_keyed_by_generation_settings(tmp_path):
