@@ -37,16 +37,32 @@ def _copy_rows(dst: np.ndarray, src: np.ndarray) -> np.ndarray:
     return out
 
 
-def import_hf_gpt2(params, state_dict: Dict[str, np.ndarray]):
-    """Return a copy of ``params`` with HF GPT-2 weights written in.
+def import_hf_gpt2(params, state_dict: Dict[str, np.ndarray],
+                   arch: str = "gpt2"):
+    """Return a copy of ``params`` with HF GPT-2/GPT-1 weights written in.
 
     ``params``: the flax param tree of GPT2DoubleHeads (fresh init).
-    ``state_dict``: HF GPT2 state dict as numpy arrays, with or without the
+    ``state_dict``: HF state dict as numpy arrays, with or without the
     ``transformer.`` prefix. ``mc_head`` is untouched. Raises KeyError when
     an expected HF tensor is missing and ValueError on inner-shape mismatch.
+
+    ``arch='openai-gpt'`` reads the GPT-1 layout (ref gpt2_train.py:262-273
+    loads either checkpoint family): embeddings are ``tokens_embed``/
+    ``positions_embed`` and there is no final LayerNorm. The per-block key
+    mapping is IDENTICAL — ``ln_1``/``ln_2`` land on ``LayerNorm_0``/
+    ``LayerNorm_1`` in both archs because flax names modules in call order,
+    and post-LN reorders the calls, not the creation sequence (gpt2.py
+    Block.__call__). HF's OpenAIGPT 'gelu' is gelu_new (tanh approx),
+    matching flax ``nn.gelu``; layer_norm_epsilon is 1e-5 in both.
     """
+    if arch not in ("gpt2", "openai-gpt"):
+        raise ValueError(f"unknown arch {arch!r}")
     sd = {k.removeprefix("transformer."): np.asarray(v, np.float32)
           for k, v in state_dict.items()}
+    if arch == "openai-gpt":
+        wte_key, wpe_key = "tokens_embed.weight", "positions_embed.weight"
+    else:
+        wte_key, wpe_key = "wte.weight", "wpe.weight"
 
     import jax
     from flax.core import unfreeze
@@ -65,10 +81,8 @@ def import_hf_gpt2(params, state_dict: Dict[str, np.ndarray]):
                 f"HF has {value.shape}")
         d[last] = value
 
-    p["wte"]["embedding"] = _copy_rows(p["wte"]["embedding"],
-                                       sd["wte.weight"])
-    p["wpe"]["embedding"] = _copy_rows(p["wpe"]["embedding"],
-                                       sd["wpe.weight"])
+    p["wte"]["embedding"] = _copy_rows(p["wte"]["embedding"], sd[wte_key])
+    p["wpe"]["embedding"] = _copy_rows(p["wpe"]["embedding"], sd[wpe_key])
 
     n_layer = sum(1 for k in p if k.startswith("Block_"))
     for i in range(n_layer):
@@ -91,8 +105,9 @@ def import_hf_gpt2(params, state_dict: Dict[str, np.ndarray]):
         put(sd[f"{h}.mlp.c_proj.weight"], b, "Dense_1", "kernel")
         put(sd[f"{h}.mlp.c_proj.bias"], b, "Dense_1", "bias")
 
-    put(sd["ln_f.weight"], "LayerNorm_0", "scale")
-    put(sd["ln_f.bias"], "LayerNorm_0", "bias")
+    if arch == "gpt2":
+        put(sd["ln_f.weight"], "LayerNorm_0", "scale")
+        put(sd["ln_f.bias"], "LayerNorm_0", "bias")
     return p
 
 
@@ -102,11 +117,16 @@ def load_hf_state_dict(model_checkpoint: str = "gpt2",
 
     Probe this FIRST (it is cheap relative to a GPT-2-small init) so the
     caller only builds base params when there is something to import.
+    ``openai-gpt`` checkpoints load through the GPT-1 model class
+    (ref gpt2_train.py:262-273 chooses the class by name the same way).
     """
     try:
-        from transformers import GPT2LMHeadModel
-        hf = GPT2LMHeadModel.from_pretrained(model_checkpoint,
-                                             local_files_only=True)
+        if "openai-gpt" in model_checkpoint:
+            from transformers import OpenAIGPTLMHeadModel as _HFModel
+        else:
+            from transformers import GPT2LMHeadModel as _HFModel
+        hf = _HFModel.from_pretrained(model_checkpoint,
+                                      local_files_only=True)
     except Exception as e:
         if verbose:
             print(f"pretrained {model_checkpoint!r} not locally cached "
@@ -116,7 +136,8 @@ def load_hf_state_dict(model_checkpoint: str = "gpt2",
 
 
 def try_load_hf_pretrained(params, model_checkpoint: str = "gpt2",
-                           verbose: bool = True) -> Optional[dict]:
+                           verbose: bool = True,
+                           arch: str = "gpt2") -> Optional[dict]:
     """Import weights from a locally-cached HF checkpoint, or None.
 
     Mirrors the reference's from_pretrained (gpt2_train.py:262-273) under
@@ -129,7 +150,7 @@ def try_load_hf_pretrained(params, model_checkpoint: str = "gpt2",
     if sd is None:
         return None
     try:
-        out = import_hf_gpt2(params, sd)
+        out = import_hf_gpt2(params, sd, arch=arch)
     except (KeyError, ValueError) as e:
         if verbose:
             print(f"pretrained {model_checkpoint!r} does not fit this model "

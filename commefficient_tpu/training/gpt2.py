@@ -111,11 +111,12 @@ def train(args, mesh=None, max_rounds=None, log=True):
 
     sample_in = (sample[0], sample[4], sample[1])
     init_params = None
-    if args.model == "gpt2":
+    if args.model in ("gpt2", "openai-gpt"):
         # finetune from HF-pretrained weights when a local cache exists
-        # (ref gpt2_train.py:262-285); requires the matching HF tokenizer —
-        # byte-level fallback vocab rows would misalign with BPE rows.
-        # Probe the cache BEFORE paying a 124M-param init for base params.
+        # (ref gpt2_train.py:262-285, either checkpoint family); requires
+        # the matching HF tokenizer — byte-level fallback vocab rows would
+        # misalign with BPE rows. Probe the cache BEFORE paying a
+        # 124M-param init for base params.
         from commefficient_tpu.data.tokenizer import HFTokenizerWrapper
         if isinstance(tokenizer, HFTokenizerWrapper):
             from commefficient_tpu.models.gpt2_import import (
@@ -125,7 +126,7 @@ def train(args, mesh=None, max_rounds=None, log=True):
                 base = model.init(jax.random.PRNGKey(args.seed), *sample_in,
                                   train=False)["params"]
                 try:
-                    init_params = import_hf_gpt2(base, sd)
+                    init_params = import_hf_gpt2(base, sd, arch=gcfg.arch)
                     print(f"loaded pretrained HF {args.model_checkpoint!r}")
                 except (KeyError, ValueError) as e:
                     print(f"pretrained {args.model_checkpoint!r} does not "

@@ -19,9 +19,18 @@ import jax
 import numpy as np
 
 
+#: .npz format history. v2 adds ``leaf_paths`` (the JSON list of pytree key
+#: paths, one per ``arr_i``) so loading aligns arrays to state leaves BY
+#: NAME — a missing leaf is backfilled or rejected per-path instead of
+#: being inferred from array count + trailing shape, which could silently
+#: misalign equal-shaped adjacent leaves (ADVICE r3).
+FORMAT_VERSION = 2
+
+
 def _state_arrays(state):
-    flat, treedef = jax.tree_util.tree_flatten(state)
-    return flat, treedef
+    paths_and_leaves, treedef = jax.tree_util.tree_flatten_with_path(state)
+    paths = [jax.tree_util.keystr(p) for p, _ in paths_and_leaves]
+    return [x for _, x in paths_and_leaves], paths, treedef
 
 
 def save_checkpoint(path: str, learner, name: str = "model",
@@ -30,7 +39,7 @@ def save_checkpoint(path: str, learner, name: str = "model",
     num_classes, ...) enabling cross-task finetune head swaps."""
     os.makedirs(path, exist_ok=True)
     fn = os.path.join(path, f"{name}.npz")
-    flat, _ = _state_arrays(learner.state)
+    flat, paths, _ = _state_arrays(learner.state)
     # record which leaf is the global weight vector so finetune can load it
     # without reconstructing this run's FedState treedef (and without
     # storing the dominant array twice)
@@ -39,30 +48,57 @@ def save_checkpoint(path: str, learner, name: str = "model",
     np.savez(fn, rounds_done=learner.rounds_done,
              total_download_bytes=learner.total_download_bytes,
              total_upload_bytes=learner.total_upload_bytes,
-             weights_idx=widx, **extra,
+             weights_idx=widx, format_version=FORMAT_VERSION,
+             leaf_paths=np.asarray(json.dumps(paths)), **extra,
              **{f"arr_{i}": np.asarray(x) for i, x in enumerate(flat)})
     return fn
+
+
+#: leaves that may legitimately be absent from an older checkpoint, and the
+#: value to backfill (state fields grown after the format was introduced)
+_BACKFILL = {".aborted": lambda cur: np.zeros((), bool)}
 
 
 def load_checkpoint(fn: str, learner) -> None:
     """Restore in place; the learner must be built with the same config."""
     with np.load(fn) as z:
-        flat, treedef = _state_arrays(learner.state)
+        flat, paths, treedef = _state_arrays(learner.state)
         n_saved = sum(1 for k in z.files if k.startswith("arr_"))
-        restored = [z[f"arr_{i}"] for i in range(n_saved)]
-        if n_saved == len(flat) - 1 and flat[-1].shape == ():
-            # pre-NaN-guard checkpoint: FedState gained a trailing scalar
-            # `aborted` leaf; backfill False so old checkpoints keep loading
-            restored.append(np.zeros((), bool))
-        elif n_saved != len(flat):
-            raise ValueError(
-                f"checkpoint {fn} has {n_saved} state arrays, learner "
-                f"expects {len(flat)} — config/mode mismatch")
+        if "leaf_paths" in z.files:
+            # v2: align saved arrays to current leaves by key path
+            saved_paths = json.loads(str(z["leaf_paths"]))
+            by_path = {p: z[f"arr_{i}"] for i, p in enumerate(saved_paths)}
+            unknown = set(saved_paths) - set(paths)
+            if unknown:
+                raise ValueError(
+                    f"checkpoint {fn} has state leaves {sorted(unknown)} the "
+                    f"learner doesn't — config/mode mismatch")
+            restored = []
+            for p in paths:
+                if p in by_path:
+                    restored.append(by_path[p])
+                elif p in _BACKFILL:
+                    restored.append(_BACKFILL[p](None))
+                else:
+                    raise ValueError(
+                        f"checkpoint {fn} is missing state leaf {p!r} — "
+                        f"config/mode mismatch")
+        else:
+            # v1 (no leaf list): positional with the historical trailing-
+            # scalar heuristic for pre-NaN-guard files
+            restored = [z[f"arr_{i}"] for i in range(n_saved)]
+            if n_saved == len(flat) - 1 and flat[-1].shape == ():
+                restored.append(np.zeros((), bool))
+            elif n_saved != len(flat):
+                raise ValueError(
+                    f"checkpoint {fn} has {n_saved} state arrays, learner "
+                    f"expects {len(flat)} — config/mode mismatch")
         for i, (cur, new) in enumerate(zip(flat, restored)):
             if tuple(cur.shape) != tuple(new.shape):
                 raise ValueError(
-                    f"checkpoint {fn} array {i} has shape {new.shape}, "
-                    f"learner expects {cur.shape} — model/config mismatch")
+                    f"checkpoint {fn} array {i} ({paths[i]}) has shape "
+                    f"{new.shape}, learner expects {cur.shape} — "
+                    f"model/config mismatch")
         learner.state = jax.tree_util.tree_unflatten(
             treedef, [jax.numpy.asarray(x) for x in restored])
         learner.rounds_done = int(z["rounds_done"])

@@ -123,6 +123,104 @@ def task_flags(task: str, quick: bool) -> list:
             "--weight_decay", "1e-4", "--seed", "21"]
 
 
+# --- the round-4 tuning grid (VERDICT r3 #1) --------------------------------
+# Per-mode LR ranges STRADDLE each mode's round-3 operating point so the
+# tuned-best is an interior point, not an endpoint; every mode's headline
+# number becomes "best LR over this probe, mean over GRID_SEEDS".
+GRID_LRS = {
+    "uncompressed": ["0.02", "0.04", "0.08", "0.15"],
+    "sketch": ["0.04", "0.08", "0.2", "0.4"],
+    "true_topk": ["0.04", "0.08", "0.2", "0.4"],
+    "local_topk": ["0.01", "0.02", "0.05", "0.1"],
+    "fedavg": ["0.02", "0.05", "0.1", "0.2"],
+}
+GRID_SEEDS = ("21", "42", "77")
+
+# local_topk mechanism diagnostics (VERDICT r3 Missing #3): the paper's own
+# thesis is that local error accumulation degrades under client subsampling
+# (error memory goes stale between a client's participations). If that — and
+# not an implementation bug (ruled out by the hand-computed trace test,
+# tests/test_round.py) — explains the gap, accuracy must climb when k grows
+# (less error held back), when data is iid (client updates agree), and when
+# participation rises 10% -> 50% (fresher error memory).
+LOCAL_TOPK_DIAG = [
+    ("k200k", ["--k", "200000"]),
+    ("k500k", ["--k", "500000"]),
+    ("iid", ["--iid"]),
+    ("participation50", ["--num_workers", "50"]),
+]
+
+
+def _grid_label(mode: str, lr: str, seed: str) -> str:
+    return f"{mode}_lr{lr}_s{seed}"
+
+
+def run_grid(out: str = "RESULTS_grid", quick: bool = False) -> list:
+    """Resumable patches32 (mode x lr x seed) grid + local_topk diagnostics.
+
+    Incremental: rows are keyed by label and written to ``{out}.json`` after
+    every run, so an interrupted grid continues where it stopped.
+    """
+    if quick:
+        out = out + "_smoke"   # never mix smoke rows into the real artifact
+    path = f"{out}.json"
+    rows = []
+    if os.path.exists(path) and not quick:
+        with open(path) as f:
+            rows = json.load(f)["results"]
+    done = {r["mode"] for r in rows}
+    grid_lrs = GRID_LRS
+    seeds = GRID_SEEDS
+    diags = LOCAL_TOPK_DIAG
+    if quick:  # plumbing smoke: 2 LRs x 2 seeds x 1 diag
+        grid_lrs = {m: lrs[:2] for m, lrs in GRID_LRS.items()}
+        seeds = GRID_SEEDS[:2]
+        diags = LOCAL_TOPK_DIAG[:1]
+
+    def launch(mode, lr, seed, label, extra=()):
+        if label in done:
+            return
+        r = run_one("patches32", mode, quick,
+                    variant=(label, ["--lr_scale", lr, "--seed", seed,
+                                     *extra]))
+        r.update(base_mode=mode, lr=float(lr), seed=int(seed))
+        rows.append(r)
+        done.add(label)
+        with open(path, "w") as f:
+            json.dump({"results": rows}, f, indent=1)
+
+    # stage A: LR probe at the base seed
+    for mode, lrs in grid_lrs.items():
+        for lr in lrs:
+            launch(mode, lr, seeds[0], _grid_label(mode, lr, seeds[0]))
+
+    # stage B: remaining seeds at each mode's tuned-best LR
+    for mode in grid_lrs:
+        lr = best_lr(rows, mode)
+        for seed in seeds[1:]:
+            launch(mode, lr, seed, _grid_label(mode, lr, seed))
+
+    # stage C: local_topk mechanism diagnostics at its tuned-best LR
+    lt_lr = best_lr(rows, "local_topk")
+    for dlabel, extra in diags:
+        launch("local_topk", lt_lr, seeds[0],
+               f"local_topk_diag_{dlabel}_lr{lt_lr}", extra)
+    return rows
+
+
+def best_lr(rows: list, mode: str) -> str:
+    """Tuned-best LR for a mode: highest base-seed accuracy, diverged runs
+    excluded (a diverging LR is outside the feasible set, not a 0-acc run)."""
+    base_seed = int(GRID_SEEDS[0])
+    cand = [(r["final_test_acc"], r["lr"]) for r in rows
+            if r.get("base_mode") == mode and r.get("seed") == base_seed
+            and not r["aborted"] and r["final_test_acc"] is not None
+            and "diag" not in r["mode"]]
+    if not cand:
+        raise RuntimeError(f"no surviving grid rows for {mode}")
+    return f"{max(cand)[1]:g}"
+
+
 SWEEP = [
     # the paper's actual deliverable is a CURVE: accuracy at several byte
     # budgets per mode. Variants override the compression size flags on
@@ -176,6 +274,8 @@ def run_one(task: str, mode: str, quick: bool, variant=None) -> dict:
     out = {
         "task": task, "mode": label, "aborted": aborted,
         "grad_size": d,
+        "lr": float(args.lr_scale),
+        "seed": int(args.seed),
         "final_test_acc": (None if aborted or "test_acc" not in row
                            else float(row["test_acc"])),
         "final_nll": (float(row["nll"]) if not aborted and "nll" in row
@@ -199,6 +299,74 @@ def run_one(task: str, mode: str, quick: bool, variant=None) -> dict:
           f"down={out['download_bytes_total']/2**20:.1f}MiB "
           f"rounds={out['rounds']} ({wall:.0f}s)", flush=True)
     return out
+
+
+def tuned_rows(grid: list) -> list:
+    """One representative patches32 row per mode from the grid: the seed-21
+    run at the tuned-best LR, annotated with the seed statistics (acc mean /
+    min / max over GRID_SEEDS) so RESULTS.md reports tuned-best vs
+    tuned-best with error bars, never a single untuned run."""
+    out = []
+    for mode in GRID_LRS:
+        lr = float(best_lr(grid, mode))
+        seed_rows = [r for r in grid
+                     if r.get("base_mode") == mode and r.get("lr") == lr
+                     and "diag" not in r["mode"] and not r["aborted"]]
+        accs = [r["final_test_acc"] for r in seed_rows]
+        rep = dict(next(r for r in seed_rows
+                        if r["seed"] == int(GRID_SEEDS[0])))
+        rep.update(mode=mode, acc_mean=float(np.mean(accs)),
+                   acc_min=min(accs), acc_max=max(accs),
+                   n_seeds=len(accs),
+                   final_test_acc=float(np.mean(accs)))
+        out.append(rep)
+    return out
+
+
+def write_grid_markdown(grid: list, path: str = "RESULTS_grid.md") -> None:
+    lines = [
+        "# Tuning grid — patches32, per-mode LR x seed",
+        "",
+        "Every cell is a full 24-epoch federated run on the spatially "
+        "disjoint Patches32 split (data/offline.py). Stage A probes "
+        "each mode's LR range at seed 21; stage B re-runs the tuned-best "
+        "LR on the remaining seeds; stage C probes local_topk's failure "
+        "mechanism (see results.py LOCAL_TOPK_DIAG).",
+        "",
+        "## Stage A+B: accuracy by (mode, lr, seed)",
+        "",
+        "| mode | lr | seed | final val acc |",
+        "|---|---|---|---|",
+    ]
+    main_rows = [r for r in grid if "diag" not in r["mode"]]
+    for r in sorted(main_rows, key=lambda r: (r["base_mode"], r["lr"],
+                                              r["seed"])):
+        acc = "DIVERGED" if r["aborted"] else f"{r['final_test_acc']:.4f}"
+        lines.append(f"| {r['base_mode']} | {r['lr']:g} | {r['seed']} | "
+                     f"{acc} |")
+    diag = [r for r in grid if "diag" in r["mode"]]
+    if diag:
+        base = next((r for r in main_rows
+                     if r["base_mode"] == "local_topk"
+                     and f"{r['lr']:g}" == best_lr(grid, "local_topk")
+                     and r["seed"] == int(GRID_SEEDS[0])), None)
+        lines += ["", "## Stage C: local_topk mechanism diagnostics", "",
+                  "Baseline = tuned local_topk (k=50k, non-iid, 10% "
+                  "participation"
+                  + (f", acc {base['final_test_acc']:.4f}" if base else "")
+                  + "). If stale-error-under-subsampling explains the gap "
+                  "(the paper's own thesis), accuracy must climb with k, "
+                  "with iid data, and with participation.", "",
+                  "| variant | final val acc | upload/client/round |",
+                  "|---|---|---|"]
+        for r in diag:
+            acc = "DIVERGED" if r["aborted"] else f"{r['final_test_acc']:.4f}"
+            lines.append(
+                f"| {r['mode']} | {acc} | "
+                f"{r['upload_bytes_per_client_round']/2**20:.2f} MiB |")
+    lines.append("")
+    with open(path, "w") as f:
+        f.write("\n".join(lines))
 
 
 def write_markdown(results: list, path: str = "RESULTS.md") -> None:
@@ -234,23 +402,31 @@ def write_markdown(results: list, path: str = "RESULTS.md") -> None:
             lines += ["(lower nll is better; the synthetic MC candidates "
                       "carry no signal, so nll/ppl is the learnable "
                       "target — results.py docstring)", ""]
-        lines += [f"| mode | {metric_hdr} | upload/client/round | "
+        lines += [f"| mode | lr | {metric_hdr} | upload/client/round | "
                   "upload total | upload vs uncompressed | download total | "
                   "rounds | wall |",
-                  "|---|---|" + "---|" * (7 if persona else 6)]
+                  "|---|---|---|" + "---|" * (7 if persona else 6)]
         for r in rows:
+            lr_cell = f"{r['lr']:g}" if r.get("lr") is not None else "—"
             if r["aborted"]:
                 div = "DIVERGED | —" if persona else "DIVERGED"
-                lines.append(f"| {r['mode']} | {div} | — | — | — | — | "
-                             f"{r['rounds']} | {r['wall_seconds']}s |")
+                lines.append(f"| {r['mode']} | {lr_cell} | {div} | — | — | "
+                             f"— | — | {r['rounds']} | {r['wall_seconds']}s |")
                 continue
-            metric_cell = (f"{r['final_nll']:.4f} | {r['final_ppl']:.2f}"
-                           if persona else f"{r['final_test_acc']:.4f}")
+            if persona:
+                metric_cell = f"{r['final_nll']:.4f} | {r['final_ppl']:.2f}"
+            elif "acc_mean" in r:
+                # tuned-grid row: seed mean with min-max spread
+                metric_cell = (f"{r['acc_mean']:.4f} "
+                               f"[{r['acc_min']:.4f}-{r['acc_max']:.4f}, "
+                               f"{r['n_seeds']} seeds]")
+            else:
+                metric_cell = f"{r['final_test_acc']:.4f}"
             upx = (base["upload_bytes_total"] / r["upload_bytes_total"]
                    if base and r["upload_bytes_total"] else None)
             up_cell = f"{upx:.1f}x less" if upx is not None else "—"
             lines.append(
-                f"| {r['mode']} | {metric_cell} | "
+                f"| {r['mode']} | {lr_cell} | {metric_cell} | "
                 f"{r['upload_bytes_per_client_round']/2**20:.2f} MiB | "
                 f"{r['upload_bytes_total']/2**30:.2f} GiB | "
                 f"{up_cell} | "
@@ -271,11 +447,41 @@ def main():
     ap.add_argument("--sweep", action="store_true",
                     help="run the byte-budget sweep variants (SWEEP) on "
                          "patches32 instead of the base modes")
+    ap.add_argument("--grid", action="store_true",
+                    help="run the patches32 LR x seed tuning grid + "
+                         "local_topk diagnostics (resumable), then fold "
+                         "tuned-best rows into RESULTS.{json,md}")
     ap.add_argument("--out", default=None,
                     help="artifact basename (default RESULTS, or "
                          "RESULTS_smoke under --quick so a smoke run can "
                          "never clobber or leak into the real artifact)")
     args = ap.parse_args()
+    if args.grid:
+        grid = run_grid(quick=args.quick)
+        if args.quick:
+            # exercise the whole reporting path against smoke filenames so
+            # a reporting bug can't survive to the end of the real grid
+            write_grid_markdown(grid, "RESULTS_grid_smoke.md")
+            print(f"quick grid smoke done ({len(tuned_rows(grid))} tuned "
+                  "rows; real artifacts untouched)")
+            return
+        write_grid_markdown(grid)
+        # replace the patches32 base-mode rows in RESULTS with tuned rows
+        results = []
+        if os.path.exists("RESULTS.json"):
+            with open("RESULTS.json") as f:
+                results = [r for r in json.load(f)["results"]
+                           if not (r["task"] == "patches32"
+                                   and r["mode"] in MODES)]
+        results = tuned_rows(grid) + results
+        task_idx = {"patches32": 0, "digits": 1, "persona": 2}
+        results.sort(key=lambda r: (task_idx.get(r["task"], 3), r["mode"]))
+        with open("RESULTS.json", "w") as f:
+            json.dump({"quick": False, "results": results}, f, indent=1)
+        write_markdown(results)
+        print("wrote RESULTS_grid.{json,md} and folded tuned rows into "
+              "RESULTS.{json,md}")
+        return
     if args.out is None:
         args.out = "RESULTS_smoke" if args.quick else "RESULTS"
     elif args.quick and args.out == "RESULTS":

@@ -53,6 +53,49 @@ def test_checkpoint_midtraining_resume(tmp_path):
                                                           abs=1e-7)
 
 
+def test_checkpoint_mode_mismatch_rejected_by_leaf_path(tmp_path):
+    # v2 checkpoints carry the pytree key-path list; loading into a learner
+    # with DIFFERENT state leaves must fail loudly by name — never shift
+    # equal-shaped adjacent leaves into the wrong slots (ADVICE r3)
+    ids, b, m = batch()
+    a = make_learner()   # uncompressed: no per-client rows
+    a.train_round(ids, b, m)
+    fn = save_checkpoint(str(tmp_path), a, "toy")
+    cfg = FedConfig(mode="local_topk", error_type="local", k=1,
+                    virtual_momentum=0.0, local_momentum=0.9, weight_decay=0,
+                    num_workers=1, num_clients=2, lr_scale=0.02)
+    model = ToyLinear()
+    other = FedLearner(model, cfg, make_regression_loss(model), None,
+                       jax.random.PRNGKey(0), X[:1])
+    with pytest.raises(ValueError, match="missing state leaf"):
+        load_checkpoint(fn, other)
+
+
+def test_checkpoint_v2_backfills_missing_aborted_leaf(tmp_path):
+    # a v2 file written before a whitelisted state field existed loads with
+    # the documented backfill (checkpoint._BACKFILL), keyed by path — not
+    # by array-count inference
+    import json as pyjson
+    ids, b, m = batch()
+    a = make_learner()
+    a.train_round(ids, b, m)
+    fn = save_checkpoint(str(tmp_path), a, "toy")
+    with np.load(fn) as z:
+        data = {k: z[k] for k in z.files}
+    paths = pyjson.loads(str(data["leaf_paths"]))
+    drop = next(i for i, p in enumerate(paths) if p == ".aborted")
+    # rewrite the file without the aborted leaf (renumber the tail)
+    arrs = [data[f"arr_{i}"] for i in range(len(paths))]
+    del arrs[drop], paths[drop]
+    data = {k: v for k, v in data.items() if not k.startswith("arr_")}
+    data["leaf_paths"] = np.asarray(pyjson.dumps(paths))
+    np.savez(fn, **data, **{f"arr_{i}": x for i, x in enumerate(arrs)})
+    fresh = make_learner()
+    load_checkpoint(fn, fresh)
+    assert bool(np.asarray(fresh.state.aborted)) is False
+    assert fresh.rounds_done == 1
+
+
 def test_worker_dp_noise_and_clip():
     ids, b, m = batch()
     noisy = make_learner(do_dp=True, dp_mode="worker", noise_multiplier=0.5,

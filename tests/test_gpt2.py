@@ -202,6 +202,42 @@ def test_hf_gpt2_import_logit_equivalence():
                                atol=2e-4, rtol=2e-4)
 
 
+def test_hf_openai_gpt_import_logit_equivalence():
+    # GPT-1 family (ref gpt2_train.py:262-273 loads 'openai-gpt' the same
+    # way): RANDOM tiny HF OpenAIGPT built from config, mapped into the
+    # post-LN GPT2DoubleHeads arch, must reproduce LM logits. HF's 'gelu'
+    # afn resolves to gelu_new (tanh approx) = flax nn.gelu.
+    transformers = pytest.importorskip("transformers")
+    torch = pytest.importorskip("torch")
+    from commefficient_tpu.models.gpt2_import import import_hf_gpt2
+
+    hf_cfg = transformers.OpenAIGPTConfig(
+        vocab_size=96, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+    torch.manual_seed(0)
+    hf = transformers.OpenAIGPTLMHeadModel(hf_cfg).eval()
+    sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+
+    cfg = GPT2Config(vocab_size=100, n_positions=64, n_embd=32, n_layer=2,
+                     n_head=4, dropout=0.0, arch="openai-gpt")
+    model = GPT2DoubleHeads(cfg)
+    rng = np.random.RandomState(1)
+    ids = rng.randint(0, 96, (2, 1, 10)).astype(np.int32)
+    types = rng.randint(0, 96, (2, 1, 10)).astype(np.int32)
+    mc = np.full((2, 1), 9, np.int32)
+    params = model.init(jax.random.PRNGKey(0), ids, types, mc,
+                        train=False)["params"]
+    mapped = import_hf_gpt2(params, sd, arch="openai-gpt")
+    lm, _ = model.apply({"params": mapped}, ids, types, mc, train=False)
+
+    with torch.no_grad():
+        ref = hf(input_ids=torch.tensor(ids[:, 0].astype(np.int64)),
+                 token_type_ids=torch.tensor(
+                     types[:, 0].astype(np.int64))).logits.numpy()
+    np.testing.assert_allclose(np.asarray(lm[:, 0, :, :96]), ref,
+                               atol=2e-4, rtol=2e-4)
+
+
 def test_gpt2_entrypoint_learns(tmp_path):
     from commefficient_tpu.training.gpt2 import main, train
     from commefficient_tpu.training.args import build_parser

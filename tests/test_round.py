@@ -173,6 +173,57 @@ def test_local_momentum_and_error_state_threading():
     assert np.all(np.asarray(ln.state.clients.errors)[1] == 0)
 
 
+def test_local_topk_hand_computed_two_round_trace():
+    """Full local_topk math vs a hand-computed trace (ref fed_worker.py:204-216
+    + fed_aggregator.py:544-566), with k < d so top-k DROPS a coordinate:
+    exercises error feedback persistence, local momentum accumulation on
+    unmasked coords, momentum factor masking, and server virtual momentum.
+
+    One client, one datapoint x=(1, 0.5), y=2, w0=(0,0), k=1, local m=0.9,
+    virtual rho=0.9, lr=0.1. Hand trace:
+      r1: g = 2(w.x-2)(1,.5) = (-4,-2); v=(-4,-2); e=(-4,-2);
+          topk -> (-4,0); e->(0,-2), v->(0,-2);
+          server: Vvel=(-4,0); w=(0.4, 0)
+      r2: pred=.4, g=2(-1.6)(1,.5)=(-3.2,-1.6);
+          v = g+.9(0,-2) = (-3.2,-3.4); e = (0,-2)+v = (-3.2,-5.4);
+          topk -> (0,-5.4); e->(-3.2,0), v->(-3.2,0);
+          server: Vvel = (0,-5.4)+.9(-4,0) = (-3.6,-5.4);
+          w = (0.4,0) + (0.36,0.54) = (0.76, 0.54)
+    """
+    cfg = FedConfig(mode="local_topk", error_type="local", k=1,
+                    virtual_momentum=0.9, local_momentum=0.9, weight_decay=0,
+                    num_workers=1, num_clients=2, lr_scale=0.1)
+    model = ToyLinear()
+    x = np.asarray([[[1.0, 0.5]]], np.float32)      # (W=1, B=1, 2)
+    y = np.asarray([[[2.0]]], np.float32)
+    ln = FedLearner(model, cfg, make_regression_loss(model), None,
+                    jax.random.PRNGKey(0), x[0])
+    ids = np.array([0])
+    mask = np.ones((1, 1), np.float32)
+
+    ln.train_round(ids, (x, y), mask)
+    np.testing.assert_allclose(np.asarray(ln.state.weights), [0.4, 0.0],
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(ln.state.clients.errors[0]),
+                               [0.0, -2.0], atol=1e-6)
+    np.testing.assert_allclose(np.asarray(ln.state.clients.velocities[0]),
+                               [0.0, -2.0], atol=1e-6)
+    np.testing.assert_allclose(np.asarray(ln.state.opt.Vvelocity),
+                               [-4.0, 0.0], atol=1e-6)
+
+    out = ln.train_round(ids, (x, y), mask)
+    np.testing.assert_allclose(np.asarray(ln.state.weights), [0.76, 0.54],
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ln.state.clients.errors[0]),
+                               [-3.2, 0.0], atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ln.state.clients.velocities[0]),
+                               [-3.2, 0.0], atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ln.state.opt.Vvelocity),
+                               [-3.6, -5.4], atol=1e-5)
+    # upload is k nonzeros (ref fed_aggregator.py:295)
+    assert out["upload_bytes"] == 4.0 * cfg.k
+
+
 def test_byte_accounting_uncompressed_vs_topk():
     # round 1: nothing changed yet -> 0 download. After an uncompressed
     # round every weight changed -> next participant downloads 4*d bytes.
