@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from commefficient_tpu.ops.dropout import FusedDropout
+
 
 class GPT2Config:
     def __init__(self, vocab_size=50262, n_positions=512, n_embd=768,
@@ -114,11 +116,11 @@ class CausalSelfAttention(nn.Module):
             # flash-style impls don't support attention-prob dropout;
             # apply it to the attention OUTPUT instead (documented
             # divergence, ops/attention.py module docstring)
-            y = nn.Dropout(self.dropout, deterministic=not train)(y)
+            y = FusedDropout(self.dropout)(y, deterministic=not train)
         elif self.attn_impl == "ring":
             # requires tracing inside shard_map with T sharded on seq_axis
             y = ring_attention(q, k, v, axis_name=self.seq_axis, causal=True)
-            y = nn.Dropout(self.dropout, deterministic=not train)(y)
+            y = FusedDropout(self.dropout)(y, deterministic=not train)
         else:
             att = (jnp.einsum("bqhd,bkhd->bhqk", q, k)
                    / np.sqrt(C // self.n_head))
@@ -126,12 +128,12 @@ class CausalSelfAttention(nn.Module):
             att = jnp.where(causal[None, None], att,
                             jnp.finfo(att.dtype).min)
             att = jax.nn.softmax(att, axis=-1)
-            att = nn.Dropout(self.dropout, deterministic=not train)(att)
+            att = FusedDropout(self.dropout)(att, deterministic=not train)
             y = jnp.einsum("bhqk,bkhd->bqhd", att, v)
         y = y.reshape(B, T, C)
         y = nn.Dense(C, dtype=self.dtype,
                      kernel_init=nn.initializers.normal(0.02))(y)
-        return nn.Dropout(self.dropout, deterministic=not train)(y)
+        return FusedDropout(self.dropout)(y, deterministic=not train)
 
 
 class Block(nn.Module):
@@ -165,7 +167,8 @@ class Block(nn.Module):
         attn = CausalSelfAttention(self.n_head, self.dropout,
                                    self.dtype, self.attn_impl,
                                    self.attn_block_size, self.seq_axis)
-        drop = nn.Dropout(self.dropout, deterministic=not train)
+        drop = lambda t: FusedDropout(self.dropout, name="mlp_drop")(
+            t, deterministic=not train)
         if self.post_ln:
             # GPT-1 (ref 'openai-gpt'): LN AFTER each residual add
             x = ln(x + attn(x, train))
@@ -201,7 +204,7 @@ class GPT2DoubleHeads(nn.Module):
             # (and the MC-head pick below) must be global
             pos = pos + jax.lax.axis_index(cfg.seq_axis) * T
         x = wte(ids) + wpe(pos) + wte(types)
-        x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
+        x = FusedDropout(cfg.dropout)(x, deterministic=not train)
         # static_argnums counts the flax scope as arg 0: train is arg 2
         block_cls = (nn.remat(Block, static_argnums=(2,))
                      if cfg.remat else Block)
@@ -232,7 +235,7 @@ class GPT2DoubleHeads(nn.Module):
                 jnp.where(mine[:, None], val, 0.0), cfg.seq_axis)
         else:
             picked = x[jnp.arange(B * C), mc_ids]      # (B*C, n_embd)
-        picked = nn.Dropout(cfg.dropout, deterministic=not train)(picked)
+        picked = FusedDropout(cfg.dropout)(picked, deterministic=not train)
         mc = nn.Dense(1, kernel_init=nn.initializers.normal(0.02),
                       name="mc_head")(picked)
         mc_logits = mc.reshape(B, C)

@@ -1,0 +1,72 @@
+"""Recompute-in-backward dropout — the HBM-traffic-free formulation.
+
+The reference inherits torch's dropout, whose backward reads a saved
+mask tensor. Under XLA the same pattern emerges from ``nn.Dropout``: the
+keep-mask is a forward intermediate reused by the backward pass, so it is
+materialized to HBM and read back — and the elementwise multiply around it
+breaks producer/consumer fusions on both sides. Round 3 measured the
+resulting tax on the federated GPT2 round at ~45 ms (docs/ROOFLINE.md:
+PRNG choice and flash-vs-full attention were both ruled out as the cost).
+
+``masked_dropout`` is a ``jax.custom_vjp`` whose only backward residual is
+the PRNG key (32 bytes): the backward REGENERATES the keep-bits from the
+key instead of loading a saved mask. Bit generation is cheap on TPU
+(threefry→rbg saved only ~5 ms of the 45), so trading a re-generation for
+the mask round-trip is a strict win; both passes draw from the same key,
+so forward and backward masks agree exactly. The forward becomes a pure
+elementwise op XLA can fuse into the surrounding matmul epilogues.
+
+Distributionally identical to ``flax.linen.Dropout`` (iid Bernoulli keep
+with 1/keep_prob scaling); the realized mask differs only if flax changes
+its bit-derivation. ``FusedDropout`` is the drop-in module replacement
+(same ``deterministic`` semantics, same ``'dropout'`` rng collection).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def _scaled_mask(key, rate: float, shape, dtype):
+    keep = jax.random.bernoulli(key, 1.0 - rate, shape)
+    return keep.astype(dtype) / (1.0 - rate)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2,))
+def masked_dropout(x, key, rate: float):
+    """x * Bernoulli(1-rate)/(1-rate); backward stores only ``key``."""
+    return x * _scaled_mask(key, rate, x.shape, x.dtype)
+
+
+def _fwd(x, key, rate: float):
+    return masked_dropout(x, key, rate), key
+
+
+def _bwd(rate: float, key, g):
+    # same key -> same bits -> the exact forward mask, regenerated
+    # (g has the output's shape/dtype, which is x's)
+    return g * _scaled_mask(key, rate, g.shape, g.dtype), None
+
+
+masked_dropout.defvjp(_fwd, _bwd)
+
+
+class FusedDropout(nn.Module):
+    """Drop-in for ``nn.Dropout(rate)(x, deterministic=...)`` using the
+    recompute-in-backward formulation above."""
+
+    rate: float
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool):
+        if self.rate == 0.0 or deterministic:
+            return x
+        if self.rate == 1.0:
+            # nn.Dropout's documented edge case: everything dropped, and
+            # 0/(1-rate) would be 0/0 = NaN
+            return jnp.zeros_like(x)
+        return masked_dropout(x, self.make_rng("dropout"), self.rate)

@@ -75,6 +75,113 @@ def seq_parallel_apply(mesh, model, params, input_ids, token_type_ids,
     return run(input_ids, token_type_ids, mc_token_ids)
 
 
+def _shift_labels(lm_labels):
+    """Pre-shift next-token labels at GLOBAL shape so the shard-local CE
+    never pairs a logit with a label owned by the next sequence shard:
+    shifted[t] = labels[t+1], last position -1 (ignored). Pairing logits
+    0..T-1 with shifted labels is exactly losses._lm_nll_sums' pairing of
+    logits[:-1] with labels[1:]."""
+    pad = jnp.full(lm_labels.shape[:-1] + (1,), -1, lm_labels.dtype)
+    return jnp.concatenate([lm_labels[..., 1:], pad], axis=-1)
+
+
+def make_gpt2_train_loss_seq(mesh, model, lm_coef: float = 1.0,
+                             mc_coef: float = 1.0, dp_axis: str = "clients",
+                             axis_name: str = "seq"):
+    """Sequence-parallel GPT2 LM+MC federated loss (same contract as
+    losses.make_gpt2_train_loss): batch rows shard over ``dp_axis``, the
+    sequence over ``axis_name`` with ring attention inside, per-example
+    sums psum over the seq axis. This is how ``--mesh clients=N,seq=M``
+    composes with the federated round: the round's fused-clients path calls
+    this loss ONCE on the flattened (W*B, C, T) batch (round.py
+    fused_clients), so the shard_map nests under jit, not under vmap —
+    modes needing per-worker state are rejected at the entrypoint.
+
+    Gradients flow through shard_map's transpose: the replicated params
+    input (P()) makes the backward psum over both axes automatic —
+    equivalence with the unsharded trajectory is asserted in
+    tests/test_cli_mesh.py.
+    """
+    if model.config.attn_impl != "ring":
+        raise ValueError("seq federated loss requires attn_impl='ring'")
+
+    def apply_loss(params, batch, rng, train):
+        input_ids, mc_token_ids, lm_labels, mc_labels, token_type_ids = batch
+        shifted = _shift_labels(lm_labels)
+        data_spec = P(dp_axis, None, axis_name)
+        row_spec = P(dp_axis)
+
+        @partial(shard_map, mesh=mesh,
+                 in_specs=(P(), data_spec, data_spec, data_spec,
+                           P(dp_axis, None), row_spec, P()),
+                 out_specs=(row_spec, P(None, dp_axis)),
+                 check_vma=False)
+        def run(p, ids, types, slabs, mc_ids, mc_labs, key):
+            rngs = (_shard_rngs({"dropout": key}, dp_axis, axis_name)
+                    if train else None)
+            lm, mc = model.apply({"params": p}, ids, types, mc_ids,
+                                 train=train, rngs=rngs)
+            import optax
+            valid = slabs != -1
+            safe = jnp.where(valid, slabs, 0)
+            nll = optax.softmax_cross_entropy_with_integer_labels(
+                lm.astype(jnp.float32), safe)
+            nll = jnp.where(valid, nll, 0.0)
+            nll_sum = jax.lax.psum(jnp.sum(nll, axis=(-2, -1)), axis_name)
+            tokens = jax.lax.psum(
+                jnp.sum(valid, axis=(-2, -1)).astype(jnp.float32), axis_name)
+            lm_loss = nll_sum / jnp.maximum(tokens, 1.0)
+            # mc logits are already replicated over seq (the model psums
+            # the picked hidden state, models/gpt2.py)
+            mc_loss = optax.softmax_cross_entropy_with_integer_labels(
+                mc, mc_labs)
+            loss = lm_coef * lm_loss + mc_coef * mc_loss
+            return loss, jnp.zeros((1, loss.shape[0]))
+
+        return run(params, input_ids, token_type_ids, shifted,
+                   mc_token_ids, mc_labels, rng)
+
+    return apply_loss
+
+
+def make_gpt2_val_loss_seq(mesh, model, axis_name: str = "seq"):
+    """Sequence-parallel twin of losses.make_gpt2_val_loss: only T shards
+    (eval batches are arbitrary-sized, so rows replicate); metric rows stay
+    [mc acc, nll token-sum, token count] for the exact token-weighted
+    rollup."""
+    if model.config.attn_impl != "ring":
+        raise ValueError("seq federated loss requires attn_impl='ring'")
+
+    def apply_loss(params, batch, rng, train):
+        input_ids, mc_token_ids, lm_labels, mc_labels, token_type_ids = batch
+        shifted = _shift_labels(lm_labels)
+        data_spec = P(None, None, axis_name)
+
+        @partial(shard_map, mesh=mesh,
+                 in_specs=(P(), data_spec, data_spec, data_spec, P(), P()),
+                 out_specs=(P(), P()), check_vma=False)
+        def run(p, ids, types, slabs, mc_ids, mc_labs):
+            import optax
+            lm, mc = model.apply({"params": p}, ids, types, mc_ids,
+                                 train=False)
+            valid = slabs != -1
+            safe = jnp.where(valid, slabs, 0)
+            nll = optax.softmax_cross_entropy_with_integer_labels(
+                lm.astype(jnp.float32), safe)
+            nll = jnp.where(valid, nll, 0.0)
+            nll_sum = jax.lax.psum(jnp.sum(nll, axis=(-2, -1)), axis_name)
+            tokens = jax.lax.psum(
+                jnp.sum(valid, axis=(-2, -1)).astype(jnp.float32), axis_name)
+            acc = (jnp.argmax(mc, -1) == mc_labs).astype(jnp.float32)
+            return (nll_sum / jnp.maximum(tokens, 1.0),
+                    jnp.stack([acc, nll_sum, tokens]))
+
+        return run(params, input_ids, token_type_ids, shifted,
+                   mc_token_ids, mc_labels)
+
+    return apply_loss
+
+
 def seq_dp_lm_train_step(mesh, model, params, input_ids, token_type_ids,
                          labels, *, dp_axis: str = "clients",
                          axis_name: str = "seq", train: bool = False,

