@@ -123,9 +123,10 @@ def bench_cifar_sketch(approx_recall=0.95):
     vec = jax.numpy.asarray(rng.randn(d).astype(np.float32))
     table = cs.sketch_vec(vec)
     t_null = _time(jax.jit(lambda x: x + 1.0), jax.numpy.zeros(8))
-    t_sketch = max(_time(cs.sketch_vec, vec) - t_null, 0.0)
+    # use_kernel=True: measure the same Pallas paths the round dispatches
+    t_sketch = max(_time(cs.sketch_vec, vec, True) - t_null, 0.0)
     t_unsketch = max(_time(cs.unsketch, table, cfg.k,
-                           approx_recall or None) - t_null, 0.0)
+                           approx_recall or None, True) - t_null, 0.0)
     breakdown = {
         "topk_approx_recall": approx_recall,
         "round_throughput_ms": round(round_time * 1e3, 1),

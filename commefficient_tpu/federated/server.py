@@ -98,7 +98,9 @@ def _sketched(sketched_grad, state, cfg, lr, sketch: CountSketch):
     # 'virtual' accumulates; 'none' recovers straight from the momentum table
     # (sketch+'local' is rejected by FedConfig.validate)
     err = state.Verror + v if cfg.error_type == "virtual" else v
-    vals, idxs = topk_values_indices(sketch.estimates(err), cfg.k,
+    # server-side (never vmapped): the Pallas estimate-all kernel is safe
+    vals, idxs = topk_values_indices(sketch.estimates(err, use_kernel=True),
+                                     cfg.k,
                                      cfg.topk_approx_recall or None)
     update = jnp.zeros((cfg.grad_dim,)).at[idxs].set(vals)
     # the update's footprint *in sketch space*: re-sketching only the k

@@ -292,10 +292,34 @@ class CountSketch:
         CPU/GPU backends."""
         return jax.default_backend() not in ("cpu", "gpu", "cuda", "rocm")
 
-    @partial(jax.jit, static_argnums=0)
-    def sketch_vec(self, vec: jax.Array) -> jax.Array:
+    def _kernel_ok(self, use_kernel: bool) -> bool:
+        """Pallas-kernel dispatch gate. The kernels are OPT-IN per call
+        site (``use_kernel=True``) because they are NOT vmap-safe: JAX's
+        pallas_call batching rule prepends the batch axis to the grid, so
+        ``pl.program_id(0)`` would become the batch index and the tiling
+        (and sketch_vec's step-0 accumulator init) would be silently wrong
+        (review r4). The federated round's per-worker vmap path therefore
+        never opts in; the aggregate-side call sites (round.py
+        sketch-after-aggregate, server.py unsketch) do."""
+        if not use_kernel:
+            return False
+        from commefficient_tpu.ops.sketch_kernels import kernel_supported
+        # the tunneled chip's backend can be named 'tpu' or 'axon'
+        return (kernel_supported(self)
+                and jax.default_backend() in ("tpu", "axon"))
+
+    @partial(jax.jit, static_argnums=(0, 2))
+    def sketch_vec(self, vec: jax.Array,
+                   use_kernel: bool = False) -> jax.Array:
         """Sketch a length-d vector into an (r, c_eff) table."""
         if self.scheme == "tiled" and self._use_routed():
+            # Pallas kernel (see estimates below): out_ref doubles as the
+            # VMEM-resident accumulator. Bit-identical; measured 16.8 ms
+            # vs 24.9 ms for the XLA path at d=6.5M, 5x500k (quiet chip).
+            if self._kernel_ok(use_kernel):
+                from commefficient_tpu.ops.sketch_kernels import \
+                    sketch_vec_pallas
+                return sketch_vec_pallas(self, vec)
             vp = vec
             if self.d_pad != self.d:
                 vp = jnp.pad(vec, (0, self.d_pad - self.d))
@@ -341,19 +365,19 @@ class CountSketch:
 
         return jnp.stack([one_row(row) for row in range(self.r)])
 
-    @partial(jax.jit, static_argnums=0)
-    def estimates(self, table: jax.Array) -> jax.Array:
+    @partial(jax.jit, static_argnums=(0, 2))
+    def estimates(self, table: jax.Array,
+                  use_kernel: bool = False) -> jax.Array:
         """Median-of-rows unbiased estimates of all d coordinates."""
         if self.scheme == "tiled" and self._use_routed():
             # Pallas kernel: VMEM-resident table, per-block window slices,
             # in-register permute/sign/median — no permuted-copies
             # intermediate at all. Bit-identical (no reassociable sums;
-            # tests/test_sketch_kernels.py). Gated on the REAL backend —
-            # not _use_routed(), which tests monkeypatch to force the
-            # routed XLA path on CPU, where Pallas only interprets.
-            from commefficient_tpu.ops.sketch_kernels import (
-                estimates_pallas, kernel_supported)
-            if kernel_supported(self) and jax.default_backend() == "tpu":
+            # tests/test_sketch_kernels.py); opt-in per call site
+            # (_kernel_ok: the kernels are not vmap-safe).
+            if self._kernel_ok(use_kernel):
+                from commefficient_tpu.ops.sketch_kernels import \
+                    estimates_pallas
                 return estimates_pallas(self, table)
             # Permuted-copies gather: materialize all 128 XOR-lane
             # permutations of the row's windows (L * c_eff floats, e.g.
@@ -393,15 +417,15 @@ class CountSketch:
             per_row.append(table[row, buckets] * signs)
         return _median_small(per_row)
 
-    @partial(jax.jit, static_argnums=(0, 2, 3))
+    @partial(jax.jit, static_argnums=(0, 2, 3, 4))
     def unsketch(self, table: jax.Array, k: int,
-                 approx_recall=None) -> jax.Array:
+                 approx_recall=None, use_kernel: bool = False) -> jax.Array:
         """Recover the top-k coordinates (dense d-vector, zeros elsewhere).
 
         ``approx_recall`` selects with ``lax.approx_max_k`` instead of the
         exact sort (see ops/topk.py; 5.4x at d=124M, k=50k)."""
         from commefficient_tpu.ops.topk import topk
-        return topk(self.estimates(table), k, approx_recall)
+        return topk(self.estimates(table, use_kernel), k, approx_recall)
 
     @partial(jax.jit, static_argnums=0)
     def l2estimate(self, table: jax.Array) -> jax.Array:

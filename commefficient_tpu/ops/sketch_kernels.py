@@ -50,6 +50,24 @@ VMEM_TABLE_BUDGET = 10 << 20  # leave headroom under ~16 MB VMEM
 _U = jnp.uint32
 
 
+def _block_hash(coeffs_row, blk):
+    """(base, lanemask) for block ids ``blk`` — countsketch._block_hashes
+    term-for-term (one copy per concept; both kernels share it)."""
+    h5, h6 = _U(coeffs_row[4]), _U(coeffs_row[5])
+    mb = _mix(h6 * blk + h5)
+    return mb, _mix(mb ^ h5) & _U(LANES - 1)
+
+
+def _signs(coeffs_row, idx):
+    """±1 signs for coordinate ids ``idx`` — countsketch._row_signs."""
+    h1, h2, h3, h4 = (_U(c) for c in coeffs_row[:4])
+    acc = h1 * idx + h2
+    acc = acc * idx + h3
+    acc = acc * idx + h4
+    return (1 - 2 * (_mix(acc) & _U(1)).astype(jnp.int32)
+            ).astype(jnp.float32)
+
+
 def _butterfly_xor(x, lanemask):
     """y[b, l] = x[b, l ^ lanemask[b]] — countsketch._permute_xor's
     7-step butterfly, usable inside the kernel (static rolls + selects)."""
@@ -73,8 +91,7 @@ def _estimates_kernel(table_ref, out_ref, win, *, coeffs, nwindows, r):
     def body(i, carry):
         blk = (_U(i0) * _U(TILE_BLOCKS) + _U(i))
         for row in range(r):
-            h5, h6 = _U(coeffs[row][4]), _U(coeffs[row][5])
-            mb = _mix(h6 * blk + h5)
+            mb, _ = _block_hash(coeffs[row], blk)
             base = (mb % _U(nwindows)).astype(jnp.int32)
             win[row, i, :] = table_ref[row, pl.ds(base * LANES, LANES)]
         return carry
@@ -88,14 +105,8 @@ def _estimates_kernel(table_ref, out_ref, win, *, coeffs, nwindows, r):
     idx = blk_vec * _U(LANES) + lane
     per_row = []
     for row in range(r):
-        h1, h2, h3, h4, h5, h6 = (_U(c) for c in coeffs[row])
-        mb = _mix(h6 * blk_vec + h5)
-        lanemask = _mix(mb ^ h5) & _U(LANES - 1)
-        acc = h1 * idx + h2
-        acc = acc * idx + h3
-        acc = acc * idx + h4
-        signs = (1 - 2 * (_mix(acc) & _U(1)).astype(jnp.int32)
-                 ).astype(jnp.float32)
+        _, lanemask = _block_hash(coeffs[row], blk_vec)
+        signs = _signs(coeffs[row], idx)
         per_row.append(_butterfly_xor(win[row], lanemask) * signs)
     out_ref[:, :] = _median(per_row)
 
@@ -128,3 +139,67 @@ def kernel_supported(cs) -> bool:
     and a table that fits the VMEM residency budget."""
     return (cs.scheme == "tiled" and cs.r in (1, 3, 5)
             and cs.r * cs.c_eff * 4 <= VMEM_TABLE_BUDGET)
+
+
+def _sketch_kernel(vec_ref, out_ref, win, *, coeffs, nwindows, r, n_tiles):
+    """Scatter direction: TPU grid steps run SEQUENTIALLY on a core, and
+    the output block's index_map is constant, so ``out_ref`` itself is the
+    VMEM-resident accumulator across steps (a separate scratch table
+    doubled VMEM and OOM'd at the 5x500k config) — the per-window '+='
+    needs no atomics. Additions hit each window in ascending block order —
+    the same order as the XLA paths (segment_sum groups by base in block
+    order; the XOR permutation guarantees one value per bucket per block),
+    so the result is bit-identical."""
+    del n_tiles
+    i0 = pl.program_id(0)
+
+    @pl.when(i0 == 0)
+    def _():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    # vectorized: sign-multiply + XOR-permute the tile (the butterfly is an
+    # involution: the same permute serves scatter and gather)
+    blk_vec = (_U(i0) * _U(TILE_BLOCKS)
+               + jax.lax.broadcasted_iota(_U, (TILE_BLOCKS, LANES), 0))
+    lane = jax.lax.broadcasted_iota(_U, (TILE_BLOCKS, LANES), 1)
+    idx = blk_vec * _U(LANES) + lane
+    x = vec_ref[:, :]
+    for row in range(r):
+        _, lanemask = _block_hash(coeffs[row], blk_vec)
+        win[row, :, :] = _butterfly_xor(x * _signs(coeffs[row], idx),
+                                        lanemask)
+
+    # scalar: accumulate each block's window at its hashed base
+    def body(i, carry):
+        blk = _U(i0) * _U(TILE_BLOCKS) + _U(i)
+        for row in range(r):
+            mb, _ = _block_hash(coeffs[row], blk)
+            base = (mb % _U(nwindows)).astype(jnp.int32)
+            sl = pl.ds(base * LANES, LANES)
+            out_ref[row, sl] = out_ref[row, sl] + win[row, i, :]
+        return carry
+
+    jax.lax.fori_loop(0, TILE_BLOCKS, body, 0)
+
+
+@partial(jax.jit, static_argnames=("cs", "interpret"))
+def sketch_vec_pallas(cs, vec, interpret: bool = False):
+    """Drop-in for ``cs.sketch_vec(vec)`` when ``kernel_supported(cs)``."""
+    n_tiles = -(-cs.nblocks // TILE_BLOCKS)
+    # zero-pad so tail-tile blocks contribute exact zeros to their windows
+    vp = jnp.pad(vec, (0, n_tiles * TILE_BLOCKS * LANES - cs.d)
+                 ).reshape(n_tiles * TILE_BLOCKS, LANES)
+    return pl.pallas_call(
+        partial(_sketch_kernel, coeffs=cs.coeffs, nwindows=cs.nwindows,
+                r=cs.r, n_tiles=n_tiles),
+        grid=(n_tiles,),
+        in_specs=[pl.BlockSpec((TILE_BLOCKS, LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((cs.r, cs.c_eff), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((cs.r, cs.c_eff), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((cs.r, TILE_BLOCKS, LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(vp)

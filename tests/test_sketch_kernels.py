@@ -9,7 +9,8 @@ import pytest
 
 from commefficient_tpu.ops.countsketch import CountSketch
 from commefficient_tpu.ops.sketch_kernels import (estimates_pallas,
-                                                 kernel_supported)
+                                                 kernel_supported,
+                                                 sketch_vec_pallas)
 
 
 @pytest.mark.parametrize("d,c,r", [(40_000, 3_000, 5), (9_999, 1_111, 3),
@@ -38,6 +39,17 @@ def test_kernel_recovers_heavy_hitters():
                                       interpret=True))
     top = np.argsort(-np.abs(est))[:k]
     assert len(set(top) & set(hot)) >= k - 1
+
+
+@pytest.mark.parametrize("d,c,r", [(40_000, 3_000, 5), (9_999, 1_111, 3)])
+def test_sketch_kernel_bit_identical(d, c, r):
+    cs = CountSketch(d=d, c=c, r=r, seed=5, scheme="tiled")
+    rng = np.random.RandomState(2)
+    vec = rng.randn(d).astype(np.float32)
+    ref = np.asarray(cs.sketch_vec(vec))
+    ker = np.asarray(sketch_vec_pallas(cs, jax.numpy.asarray(vec),
+                                       interpret=True))
+    np.testing.assert_array_equal(ker, ref)
 
 
 def test_kernel_supported_gate():
