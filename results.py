@@ -42,9 +42,20 @@ available offline, see data/offline.py):
   target — the synthetic MC candidates are random, so mc_acc carries no
   signal and is not reported.
 
+* **persona_small** (NLP at the real scale): gpt2-small with the vocab
+  table padded to the HF row count (d = 124.4M) so the byte ratios are
+  the reference experiment's exactly; modes uncompressed/sketch/
+  local_topk at the paper's 5x500k / k=50k budgets. NOTE: local_topk's
+  per-client state (2 x n_clients x d floats) exceeds one chip's HBM at
+  50 clients — the reference keeps that state in host shm; here it is
+  device-resident and shards over the `clients` mesh axis, so the
+  single-chip artifact records a reduced-client variant.
+
 Usage:
-    python results.py                 # all 3 tasks x 5 modes (TPU, ~45min)
+    python results.py                 # all 4 tasks (TPU, ~1.5h)
     python results.py --task patches32 --modes sketch,uncompressed
+    python results.py --grid          # patches32 LR x seed tuning grid +
+                                      # local_topk diagnostics (resumable)
     python results.py --sweep         # byte-budget curve on patches32
     python results.py --quick         # tiny smoke (CI): 8 rounds per mode
 """
