@@ -139,11 +139,14 @@ def bench_cifar_sketch(approx_recall=0.95):
     return 1.0 / round_time, breakdown
 
 
-def _gpt2_fed_setup(B=8, **cfg_kw):
+def _gpt2_fed_setup(B=8, attn_impl="full", **cfg_kw):
     """Shared gpt2-small federated-bench setup: model, learner, and a
     device-resident synthetic PersonaChat batch (W=4, B dialogs, C=2,
     T=256 — 16k tokens/round at the default B=8, a realistic device
-    batch; round 2 ran 8k)."""
+    batch; round 2 ran 8k). ``attn_impl='blockwise'`` swaps in the flash
+    kernel, whose output-dropout avoids the (T,T) probability masks —
+    the measured bulk of the dropout tax (docs/ROOFLINE.md) — at a
+    documented semantic divergence from HF's attn_pdrop."""
     import jax
     import jax.numpy as jnp
 
@@ -157,6 +160,8 @@ def _gpt2_fed_setup(B=8, **cfg_kw):
     gcfg.n_positions = max(gcfg.n_positions, T)
     gcfg.dropout = 0.1
     gcfg.dtype = "bfloat16"  # MXU-native compute; params stay f32
+    gcfg.attn_impl = attn_impl
+    gcfg.attn_block_size = 256
     model = GPT2DoubleHeads(gcfg)
     cfg = FedConfig(virtual_momentum=0.9, local_momentum=0, weight_decay=0,
                     num_workers=W, num_clients=16, lr_scale=4e-2, **cfg_kw)
@@ -206,9 +211,9 @@ def _timed_windows(learner, one_round, n_windows=3, n_rounds=4):
     return float(np.median(window_times))
 
 
-def bench_gpt2_tokens():
+def bench_gpt2_tokens(attn_impl="full"):
     learner, one_round, tokens_per_round = _gpt2_fed_setup(
-        mode="uncompressed", error_type="none")
+        attn_impl=attn_impl, mode="uncompressed", error_type="none")
     return tokens_per_round / _timed_windows(learner, one_round)
 
 
@@ -299,6 +304,7 @@ def main():
         rounds_per_sec, breakdown = bench_cifar_sketch()
         cifar_exact, _ = bench_cifar_sketch(approx_recall=0.0)
         gpt2_tokens = bench_gpt2_tokens()
+        gpt2_tokens_flash = bench_gpt2_tokens(attn_impl="blockwise")
         gpt2_sketch = bench_gpt2_sketch_rounds()
         gpt2_sketch_exact = bench_gpt2_sketch_rounds(approx_recall=0.0)
         longctx_tokens = bench_longcontext_tokens()
@@ -318,6 +324,13 @@ def main():
             "metric": "gpt2_personachat_tokens_per_sec_chip",
             "value": round(gpt2_tokens, 1),
             "unit": "tokens/sec",
+        }, {
+            "metric": "gpt2_personachat_tokens_per_sec_chip_flash_attn",
+            "value": round(gpt2_tokens_flash, 1),
+            "unit": "tokens/sec",
+            "config": {"attn_impl": "blockwise",
+                       "note": "output-dropout instead of (T,T) prob "
+                               "masks — ROOFLINE.md dropout-tax A/B"},
         }, {
             "metric": "gpt2_fetchsgd_sketch_rounds_per_sec",
             "value": round(gpt2_sketch, 4),
