@@ -1,0 +1,98 @@
+"""Unit tests for results.py's artifact-generating helpers (best_lr,
+tuned_rows, write_markdown, write_grid_markdown) — pure host-side code
+that every headline table flows through, previously exercised only by
+full TPU runs."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from results import (GRID_SEEDS, best_lr, tuned_rows,  # noqa: E402
+                     write_grid_markdown, write_markdown)
+
+
+def _row(mode, lr, seed, acc, aborted=False, label=None):
+    return {
+        "task": "patches32", "mode": label or f"{mode}_lr{lr}_s{seed}",
+        "base_mode": mode, "lr": lr, "seed": seed, "aborted": aborted,
+        "grad_size": 100, "final_test_acc": None if aborted else acc,
+        "final_nll": None, "final_ppl": None, "final_train_loss": 0.5,
+        "epochs": 24, "rounds": 100, "upload_bytes_total": 1e9,
+        "download_bytes_total": 1e9, "upload_bytes_per_client_round": 1e6,
+        "wall_seconds": 10.0,
+    }
+
+
+def _grid():
+    base = int(GRID_SEEDS[0])
+    rows = []
+    for lr, acc in ((0.02, 0.30), (0.05, 0.35), (0.1, None)):
+        rows.append(_row("uncompressed", lr, base, acc, aborted=acc is None))
+    for seed, acc in ((42, 0.33), (77, 0.37)):
+        rows.append(_row("uncompressed", 0.05, seed, acc))
+    # a stage-C diagnostic row exactly as run_grid writes it on resume:
+    # base_mode local_topk, the base seed, the tuned lr, and (crucially
+    # for the test) a HIGHER accuracy than any probe row — best_lr must
+    # still ignore it
+    rows.append(_row("local_topk", 0.02, base, 0.31))
+    rows.append(_row("local_topk", 0.05, base, 0.34))
+    rows.append(_row("local_topk", 0.05, base, 0.99,
+                     label="local_topk_diag_k200k_lr0.05"))
+    rows[-1]["lr"] = 0.02
+    return rows
+
+
+def test_best_lr_excludes_diverged_and_diag_rows():
+    # 0.1 diverged -> the feasible best is 0.05
+    assert best_lr(_grid(), "uncompressed") == "0.05"
+    # the diag row (acc 0.99 at lr 0.02, base seed, base_mode local_topk)
+    # would flip the answer to 0.02 if the 'diag' exclusion were dropped —
+    # this is the resumed-grid case where the clause is load-bearing
+    assert best_lr(_grid(), "local_topk") == "0.05"
+    with pytest.raises(RuntimeError, match="no surviving"):
+        best_lr(_grid(), "sketch")
+
+
+def test_tuned_rows_mean_and_spread(monkeypatch):
+    import results as R
+    monkeypatch.setattr(R, "GRID_LRS", {"uncompressed": ["0.02", "0.05"]})
+    rows = R.tuned_rows(_grid())
+    assert len(rows) == 1
+    r = rows[0]
+    assert r["mode"] == "uncompressed"
+    assert r["n_seeds"] == 3
+    assert r["acc_min"] == 0.33 and r["acc_max"] == 0.37
+    assert abs(r["acc_mean"] - (0.35 + 0.33 + 0.37) / 3) < 1e-12
+    # the representative row's headline metric is the seed MEAN, never a
+    # single run
+    assert r["final_test_acc"] == r["acc_mean"]
+
+
+def test_write_markdown_tuned_and_plain_rows_align(tmp_path, monkeypatch):
+    import results as R
+    monkeypatch.setattr(R, "GRID_LRS", {"uncompressed": ["0.02", "0.05"]})
+    tuned = R.tuned_rows(_grid())
+    plain = [_row("sketch", 0.2, 21, 0.36, label="sketch")]
+    plain[0]["mode"] = "sketch"
+    out = tmp_path / "R.md"
+    write_markdown(tuned + plain, str(out))
+    lines = [ln for ln in out.read_text().splitlines()
+             if ln.startswith("|")]
+    ncols = {ln.count("|") for ln in lines}
+    assert ncols == {10}, "every row must carry the same column count"
+    assert any("3 seeds" in ln for ln in lines)
+
+
+def test_write_grid_markdown_sections(tmp_path, monkeypatch):
+    import results as R
+    monkeypatch.setattr(R, "GRID_LRS", {"uncompressed": ["0.02", "0.05"],
+                                        "local_topk": ["0.05"]})
+    grid = _grid() + [_row("local_topk", 0.05, 21, 0.31)]
+    out = tmp_path / "G.md"
+    write_grid_markdown(grid, str(out))
+    text = out.read_text()
+    assert "Stage A+B" in text and "Stage C" in text
+    assert "DIVERGED" in text            # the aborted lr-0.1 row
+    assert "local_topk_diag_k200k" in text
