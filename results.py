@@ -269,6 +269,8 @@ SWEEP = [
      ["--num_rows", "5", "--num_cols", "200000", "--k", "20000"]),
     ("sketch", "sketch_5x100k_k10k",
      ["--num_rows", "5", "--num_cols", "100000", "--k", "10000"]),
+    ("sketch", "sketch_5x50k_k5k",
+     ["--num_rows", "5", "--num_cols", "50000", "--k", "5000"]),
     ("true_topk", "true_topk_k10k", ["--k", "10000"]),
     ("local_topk", "local_topk_k200k", ["--k", "200000"]),
 ]
