@@ -56,16 +56,43 @@ def _lm_nll_per_example(lm_logits, lm_labels):
     return nll_sum / jnp.maximum(tokens, 1.0)
 
 
+def _fused_lm_head(model) -> bool:
+    return bool(getattr(getattr(model, "config", None),
+                        "fused_lm_head", False))
+
+
+def _fused_nll_sums(model, hidden, params, lm_labels):
+    """(nll token-sum, labeled-token count) per dialog from HIDDEN states
+    via the vocab-chunked fused head+CE (ops/fused_ce.py) — used when the
+    model was built with ``fused_lm_head=True`` and returns hidden states
+    instead of logits. Sums over the candidate axis to match
+    ``_lm_nll_sums``'s (B,) contract. The head matmul runs in the model's
+    configured compute dtype (f32 config => 1e-6-exact vs the
+    materialized-logits path, bf16 config => the same bf16-input matmuls
+    the rest of the model runs)."""
+    from commefficient_tpu.ops.fused_ce import shifted_lm_nll
+    wte = params["wte"]["embedding"]
+    nll_sum, tokens = shifted_lm_nll(hidden, wte, lm_labels,
+                                     compute_dtype=model.config.jnp_dtype)
+    return jnp.sum(nll_sum, axis=-1), jnp.sum(tokens, axis=-1)
+
+
 def make_gpt2_train_loss(model, lm_coef: float = 1.0, mc_coef: float = 1.0):
     """LM + multiple-choice loss (reference compute_loss_train,
     gpt2_train.py:88-99)."""
+    fused = _fused_lm_head(model)
 
     def apply_loss(params, batch, rng, train):
         input_ids, mc_token_ids, lm_labels, mc_labels, token_type_ids = batch
-        lm_logits, mc_logits = model.apply(
+        lm_out, mc_logits = model.apply(
             {"params": params}, input_ids, token_type_ids, mc_token_ids,
             train=train, rngs={"dropout": rng} if train else None)
-        lm_loss = _lm_nll_per_example(lm_logits, lm_labels)
+        if fused:
+            nll_sum, tokens = _fused_nll_sums(model, lm_out, params,
+                                              lm_labels)
+            lm_loss = nll_sum / jnp.maximum(tokens, 1.0)
+        else:
+            lm_loss = _lm_nll_per_example(lm_out, lm_labels)
         mc_loss = optax.softmax_cross_entropy_with_integer_labels(
             mc_logits, mc_labels)
         loss = lm_coef * lm_loss + mc_coef * mc_loss
@@ -85,12 +112,18 @@ def make_gpt2_val_loss(model):
     sum(nll_sums)/sum(token_counts) — the per-example loss channel remains
     dialog-weighted for the masked federated plumbing."""
 
+    fused = _fused_lm_head(model)
+
     def apply_loss(params, batch, rng, train):
         input_ids, mc_token_ids, lm_labels, mc_labels, token_type_ids = batch
-        lm_logits, mc_logits = model.apply(
+        lm_out, mc_logits = model.apply(
             {"params": params}, input_ids, token_type_ids, mc_token_ids,
             train=False)
-        nll_sum, tokens = _lm_nll_sums(lm_logits, lm_labels)
+        if fused:
+            nll_sum, tokens = _fused_nll_sums(model, lm_out, params,
+                                              lm_labels)
+        else:
+            nll_sum, tokens = _lm_nll_sums(lm_out, lm_labels)
         acc = (jnp.argmax(mc_logits, -1) == mc_labels).astype(jnp.float32)
         return (nll_sum / jnp.maximum(tokens, 1.0),
                 jnp.stack([acc, nll_sum, tokens]))

@@ -63,6 +63,19 @@ class GPT2Config:
         # expert-parallel axis. 0 = dense MLP (reference parity).
         self.moe_experts = 0
         self.moe_capacity_factor = 1.25
+        # 'xla' (portable recompute-in-backward masked_dropout) or
+        # 'tpu_bits' (hardware-RNG Pallas kernel, ops/dropout.py — same
+        # Bernoulli distribution, ~8x cheaper bit generation on-chip; not
+        # vmap-safe, so entrypoints only enable it on the fused round path)
+        self.dropout_impl = "xla"
+        # True: __call__ returns the final HIDDEN states (B, C, T, E)
+        # instead of lm_logits, and the loss computes CE with the
+        # vocab-chunked fused LM head (ops/fused_ce.py) — the (N, V)
+        # logits tensor never materializes. Same loss values (bf16-input
+        # matmul accuracy); the losses module branches on this flag.
+        # Not supported with attn_impl='ring' (the seq-parallel losses
+        # own their logits handling).
+        self.fused_lm_head = False
 
     @property
     def jnp_dtype(self):
@@ -95,6 +108,7 @@ class CausalSelfAttention(nn.Module):
     attn_impl: str = "full"       # 'full' | 'blockwise' | 'ring'
     attn_block_size: int = 512
     seq_axis: str = "seq"
+    dropout_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x, train: bool):
@@ -116,11 +130,13 @@ class CausalSelfAttention(nn.Module):
             # flash-style impls don't support attention-prob dropout;
             # apply it to the attention OUTPUT instead (documented
             # divergence, ops/attention.py module docstring)
-            y = FusedDropout(self.dropout)(y, deterministic=not train)
+            y = FusedDropout(self.dropout, self.dropout_impl)(
+                y, deterministic=not train)
         elif self.attn_impl == "ring":
             # requires tracing inside shard_map with T sharded on seq_axis
             y = ring_attention(q, k, v, axis_name=self.seq_axis, causal=True)
-            y = FusedDropout(self.dropout)(y, deterministic=not train)
+            y = FusedDropout(self.dropout, self.dropout_impl)(
+                y, deterministic=not train)
         else:
             att = (jnp.einsum("bqhd,bkhd->bhqk", q, k)
                    / np.sqrt(C // self.n_head))
@@ -128,12 +144,14 @@ class CausalSelfAttention(nn.Module):
             att = jnp.where(causal[None, None], att,
                             jnp.finfo(att.dtype).min)
             att = jax.nn.softmax(att, axis=-1)
-            att = FusedDropout(self.dropout)(att, deterministic=not train)
+            att = FusedDropout(self.dropout, self.dropout_impl)(
+                att, deterministic=not train)
             y = jnp.einsum("bhqk,bkhd->bqhd", att, v)
         y = y.reshape(B, T, C)
         y = nn.Dense(C, dtype=self.dtype,
                      kernel_init=nn.initializers.normal(0.02))(y)
-        return FusedDropout(self.dropout)(y, deterministic=not train)
+        return FusedDropout(self.dropout, self.dropout_impl)(
+            y, deterministic=not train)
 
 
 class Block(nn.Module):
@@ -146,6 +164,7 @@ class Block(nn.Module):
     moe_experts: int = 0
     moe_capacity_factor: float = 1.25
     post_ln: bool = False    # GPT-1 places LN after the residual add
+    dropout_impl: str = "xla"
 
     def _mlp(self, h, train: bool):
         if self.moe_experts > 0:
@@ -166,8 +185,10 @@ class Block(nn.Module):
         ln = lambda t: nn.LayerNorm(dtype=self.dtype, epsilon=1e-5)(t)
         attn = CausalSelfAttention(self.n_head, self.dropout,
                                    self.dtype, self.attn_impl,
-                                   self.attn_block_size, self.seq_axis)
-        drop = lambda t: FusedDropout(self.dropout, name="mlp_drop")(
+                                   self.attn_block_size, self.seq_axis,
+                                   self.dropout_impl)
+        drop = lambda t: FusedDropout(self.dropout, self.dropout_impl,
+                                      name="mlp_drop")(
             t, deterministic=not train)
         if self.post_ln:
             # GPT-1 (ref 'openai-gpt'): LN AFTER each residual add
@@ -180,13 +201,19 @@ class Block(nn.Module):
 
 
 class GPT2DoubleHeads(nn.Module):
-    """Returns (lm_logits (B,C,T,V), mc_logits (B,C))."""
+    """Returns (lm_logits (B,C,T,V), mc_logits (B,C)) — or, with
+    ``config.fused_lm_head``, (hidden (B,C,T,E), mc_logits (B,C)) for the
+    vocab-chunked fused head+CE in the losses module."""
     config: GPT2Config
 
     @nn.compact
     def __call__(self, input_ids, token_type_ids, mc_token_ids,
                  train: bool = True):
         cfg = self.config
+        if cfg.fused_lm_head and cfg.attn_impl == "ring":
+            raise ValueError("fused_lm_head is not supported with "
+                             "attn_impl='ring' (the seq-parallel losses "
+                             "own their logits handling)")
         B, C, T = input_ids.shape
         ids = input_ids.reshape(B * C, T)
         types = token_type_ids.reshape(B * C, T)
@@ -204,7 +231,8 @@ class GPT2DoubleHeads(nn.Module):
             # (and the MC-head pick below) must be global
             pos = pos + jax.lax.axis_index(cfg.seq_axis) * T
         x = wte(ids) + wpe(pos) + wte(types)
-        x = FusedDropout(cfg.dropout)(x, deterministic=not train)
+        x = FusedDropout(cfg.dropout, cfg.dropout_impl)(
+            x, deterministic=not train)
         # static_argnums counts the flax scope as arg 0: train is arg 2
         block_cls = (nn.remat(Block, static_argnums=(2,))
                      if cfg.remat else Block)
@@ -213,14 +241,22 @@ class GPT2DoubleHeads(nn.Module):
             x = block_cls(cfg.n_head, cfg.dropout, cfg.jnp_dtype,
                           cfg.attn_impl, cfg.attn_block_size,
                           cfg.seq_axis, cfg.moe_experts,
-                          cfg.moe_capacity_factor, post_ln)(x, train)
+                          cfg.moe_capacity_factor, post_ln,
+                          cfg.dropout_impl)(x, train)
         x = x.astype(jnp.float32)
         if not post_ln:
             x = nn.LayerNorm(epsilon=1e-5)(x)   # GPT-1 has no final LN
 
-        # LM head tied to wte (GPT-2 weight tying); logits in f32
-        lm_logits = wte.attend(x)
-        lm_logits = lm_logits.reshape(B, C, T, cfg.vocab_size)
+        if cfg.fused_lm_head:
+            # the loss applies the vocab-chunked fused head+CE
+            # (ops/fused_ce.py) to these hidden states with the tied wte
+            # weight it reads from params — the (N, V) logits tensor is
+            # never materialized
+            lm_out = x.reshape(B, C, T, cfg.n_embd)
+        else:
+            # LM head tied to wte (GPT-2 weight tying); logits in f32
+            lm_logits = wte.attend(x)
+            lm_out = lm_logits.reshape(B, C, T, cfg.vocab_size)
 
         # multiple-choice head: hidden state at each candidate's last token
         mc_ids = mc_token_ids.reshape(B * C)
@@ -239,14 +275,14 @@ class GPT2DoubleHeads(nn.Module):
             val = x[jnp.arange(B * C), local]
             mine = (mc_ids >= off) & (mc_ids < off + T)
             contrib = jnp.where(mine[:, None], val, 0.0)
-            contrib = FusedDropout(cfg.dropout)(contrib,
-                                                deterministic=not train)
+            contrib = FusedDropout(cfg.dropout, cfg.dropout_impl)(
+                contrib, deterministic=not train)
             picked = jax.lax.psum(contrib, cfg.seq_axis)
         else:
             picked = x[jnp.arange(B * C), mc_ids]      # (B*C, n_embd)
-            picked = FusedDropout(cfg.dropout)(picked,
-                                               deterministic=not train)
+            picked = FusedDropout(cfg.dropout, cfg.dropout_impl)(
+                picked, deterministic=not train)
         mc = nn.Dense(1, kernel_init=nn.initializers.normal(0.02),
                       name="mc_head")(picked)
         mc_logits = mc.reshape(B, C)
-        return lm_logits, mc_logits
+        return lm_out, mc_logits

@@ -1,0 +1,148 @@
+"""Fused LM-head + cross-entropy: chunked over the vocabulary.
+
+The reference computes the GPT2 LM loss as ``CrossEntropyLoss(ignore_index
+=-1)`` over full materialized logits (reference gpt2_train.py:77-99) — on
+TPU that materializes an (N, V) = (16k, 50k) f32 tensor (3.3 GB) through
+forward AND backward, and runs the head matmul in f32. This op computes the
+same token-level NLL with the head matmul folded in, scanning over vocab
+chunks with an online logsumexp:
+
+* forward: per chunk, ``logits_c = h @ wte_c^T`` (bf16 inputs, f32
+  accumulation on the MXU), running (max, sumexp, label-logit); only the
+  (N,) lse survives to the backward.
+* backward: recomputes each chunk's logits and feeds ``softmax - onehot``
+  straight into the two grad matmuls (dh, dwte) — the full logits tensor
+  never exists in HBM.
+
+This is the standard memory-lean CE formulation (same trick as flash
+attention's online softmax, applied to the vocab axis). Numerics: logits
+are bf16-input/f32-accum instead of the default path's f32xf32 matmul;
+max-subtracted logsumexp keeps the reduction stable. The equivalence to
+``optax.softmax_cross_entropy_with_integer_labels`` on materialized
+logits is asserted to ~1e-2 (bf16 input rounding) in tests/test_fused_ce.py,
+and exactly (1e-6) when ``compute_dtype=float32``.
+
+vmap/shard-safe: pure jnp + lax.scan (no Pallas), so it composes with the
+per-worker vmap path and shard_map, unlike the opt-in Pallas kernels.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def _chunk_logits(hb, wb_c, col0, V, compute_dtype):
+    """(N, chunk) f32 logits for one vocab chunk; padded cols -> -inf."""
+    logits = jnp.dot(hb, wb_c.T, preferred_element_type=jnp.float32)
+    cols = col0 + jnp.arange(wb_c.shape[0])
+    return jnp.where(cols[None, :] < V, logits, -jnp.inf)
+
+
+def _pad_vocab(wte, chunk):
+    V = wte.shape[0]
+    V_pad = ((V + chunk - 1) // chunk) * chunk
+    if V_pad != V:
+        wte = jnp.pad(wte, ((0, V_pad - V), (0, 0)))
+    return wte, V_pad // chunk
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def lm_head_nll(hidden, wte, labels, chunk: int = 8192,
+                compute_dtype=jnp.bfloat16):
+    """Token-level NLL of ``softmax(hidden @ wte.T)`` at ``labels``.
+
+    hidden (N, E); wte (V, E); labels (N,) int32 — positions with label -1
+    get an arbitrary value (mask them downstream, as the reference's
+    ignore_index does). Returns (N,) f32.
+    """
+    nll, _ = _fwd_impl(hidden, wte, labels, chunk, compute_dtype)
+    return nll
+
+
+def _fwd_impl(hidden, wte, labels, chunk, compute_dtype):
+    V = wte.shape[0]
+    hb = hidden.astype(compute_dtype)
+    wb, n_chunks = _pad_vocab(wte.astype(compute_dtype), chunk)
+    N = hidden.shape[0]
+
+    def body(carry, c):
+        m, s, ll = carry
+        col0 = c * chunk
+        wc = lax.dynamic_slice_in_dim(wb, col0, chunk)
+        logits = _chunk_logits(hb, wc, col0, V, compute_dtype)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=1))
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=1)
+        rel = labels - col0
+        inchunk = (rel >= 0) & (rel < chunk)
+        picked = jnp.take_along_axis(
+            logits, jnp.clip(rel, 0, chunk - 1)[:, None], axis=1)[:, 0]
+        ll = ll + jnp.where(inchunk, picked, 0.0)
+        return (m_new, s, ll), None
+
+    init = (jnp.full((N,), -jnp.inf, jnp.float32),
+            jnp.zeros((N,), jnp.float32), jnp.zeros((N,), jnp.float32))
+    (m, s, ll), _ = lax.scan(body, init, jnp.arange(n_chunks))
+    lse = m + jnp.log(s)
+    return lse - ll, lse
+
+
+def _fwd(hidden, wte, labels, chunk, compute_dtype):
+    nll, lse = _fwd_impl(hidden, wte, labels, chunk, compute_dtype)
+    return nll, (hidden, wte, labels, lse)
+
+
+def _bwd(chunk, compute_dtype, res, g):
+    hidden, wte, labels, lse = res
+    V, E = wte.shape
+    N = hidden.shape[0]
+    hb = hidden.astype(compute_dtype)
+    wb, n_chunks = _pad_vocab(wte.astype(compute_dtype), chunk)
+    V_pad = wb.shape[0]
+
+    def body(carry, c):
+        dh, dwte = carry
+        col0 = c * chunk
+        wc = lax.dynamic_slice_in_dim(wb, col0, chunk)
+        logits = _chunk_logits(hb, wc, col0, V, compute_dtype)
+        p = jnp.exp(logits - lse[:, None])          # pad cols: exp(-inf)=0
+        cols = col0 + jnp.arange(chunk)
+        onehot = (cols[None, :] == labels[:, None]).astype(jnp.float32)
+        dl = ((p - onehot) * g[:, None]).astype(compute_dtype)
+        dh = dh + jnp.dot(dl, wc, preferred_element_type=jnp.float32)
+        dw_c = jnp.dot(dl.T, hb, preferred_element_type=jnp.float32)
+        dwte = lax.dynamic_update_slice(dwte, dw_c, (col0, 0))
+        return (dh, dwte), None
+
+    init = (jnp.zeros((N, E), jnp.float32),
+            jnp.zeros((V_pad, E), jnp.float32))
+    (dh, dwte), _ = lax.scan(body, init, jnp.arange(n_chunks))
+    return (dh.astype(hidden.dtype), dwte[:V].astype(wte.dtype), None)
+
+
+lm_head_nll.defvjp(_fwd, _bwd)
+
+
+def shifted_lm_nll(hidden, wte, lm_labels, chunk: int = 8192,
+                   compute_dtype=jnp.bfloat16):
+    """The reference's shifted-CE layout on hidden states: predictions at
+    positions :-1, labels at 1:, label -1 ignored (ref gpt2_train.py:77-87).
+
+    hidden (..., T, E); lm_labels (..., T). Returns (nll_sum (...,),
+    token_count (...,)) like losses._lm_nll_sums but straight from hidden.
+    """
+    lead = hidden.shape[:-2]
+    T, E = hidden.shape[-2], hidden.shape[-1]
+    h = hidden[..., :-1, :].reshape(-1, E)
+    labels = lm_labels[..., 1:].reshape(-1)
+    valid = labels != -1
+    nll = lm_head_nll(h, wte, jnp.where(valid, labels, 0), chunk,
+                      compute_dtype)
+    nll = jnp.where(valid, nll, 0.0).reshape(lead + (T - 1,))
+    counts = valid.astype(jnp.float32).reshape(lead + (T - 1,))
+    return jnp.sum(nll, axis=-1), jnp.sum(counts, axis=-1)

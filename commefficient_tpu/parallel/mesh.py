@@ -118,5 +118,14 @@ def batch_shardings(mesh: Mesh, axis: str = "clients"):
     return worker0, worker0, worker0
 
 
+def stacked_batch_shardings(mesh: Mesh, axis: str = "clients"):
+    """Batch shardings for a K-round stacked window
+    (api.FedLearner.train_rounds_scan): the leading scan axis is
+    replicated (lax.scan consumes it sequentially), the worker axis
+    shards as in ``batch_shardings``."""
+    worker1 = _ns(mesh, None, axis)
+    return worker1, worker1, worker1
+
+
 def shard_state(state, cfg: FedConfig, mesh: Mesh):
     return jax.device_put(state, fed_state_shardings(cfg, mesh))

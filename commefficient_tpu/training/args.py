@@ -86,6 +86,14 @@ def build_parser(default_lr: float = 0.4) -> argparse.ArgumentParser:
     p.add_argument("--mesh", type=str, default="",
                    help="mesh shape as 'clients=N[,seq=M]' or 'clients=all';"
                         " empty = single-device (no mesh). See parse_mesh")
+    p.add_argument("--scan_rounds", type=int, default=1,
+                   help="dispatch K rounds per host call as one traced "
+                        "lax.scan (api.train_rounds_scan): identical "
+                        "trajectory, K-fold fewer dispatches — the host "
+                        "per-dispatch cost otherwise bounds throughput on "
+                        "remote/tunneled devices. NaN abort is detected at "
+                        "window granularity (the device guard still freezes "
+                        "state at the breaching round)")
     # GPT2 / PersonaChat (ref utils.py:185-208)
     p.add_argument("--model_checkpoint", type=str, default="gpt2")
     p.add_argument("--num_candidates", type=int, default=2)
@@ -93,6 +101,21 @@ def build_parser(default_lr: float = 0.4) -> argparse.ArgumentParser:
     p.add_argument("--lm_coef", type=float, default=1.0)
     p.add_argument("--mc_coef", type=float, default=1.0)
     p.add_argument("--personality_permutations", type=int, default=1)
+    p.add_argument("--dropout_impl", choices=("xla", "xla_rbg"),
+                   default="xla",
+                   help="dropout bit source (ops/dropout.py): 'xla_rbg' "
+                        "draws mask bits from the TPU hardware "
+                        "RngBitGenerator (~12 ms/round faster on the "
+                        "federated GPT2 bench, same Bernoulli "
+                        "distribution); 'xla' is the portable threefry "
+                        "path")
+    p.add_argument("--fused_lm_head", action="store_true",
+                   help="compute the GPT2 LM loss with the vocab-chunked "
+                        "fused head+CE (ops/fused_ce.py): the (tokens, "
+                        "vocab) logits tensor never materializes — a "
+                        "memory lever for long sequences (measured "
+                        "slightly SLOWER than XLA's fused materialized "
+                        "path at T=256, docs/ROOFLINE.md)")
     # DP
     p.add_argument("--dp", action="store_true", dest="do_dp")
     p.add_argument("--dp_mode", choices=DP_MODES, default="worker")

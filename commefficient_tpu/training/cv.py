@@ -173,17 +173,39 @@ def train(args, mesh=None, max_rounds=None, log=True):
             # (sharding-aware on a mesh: lands directly on the shards)
             from commefficient_tpu.data.prefetch import device_prefetch
             batch_sh = learner.batch_shardings
+            # --scan_rounds K>1: K rounds per host dispatch as one traced
+            # lax.scan (api.ScanWindow / train_rounds_scan) — identical
+            # trajectory, but dispatch and metric-sync costs are paid per
+            # window instead of per round. The epoch tail flushes a
+            # shorter window (one extra compile for that K).
+            scan_k = max(1, int(getattr(args, "scan_rounds", 1) or 1))
+            window = learner.scan_window(scan_k) if scan_k > 1 else None
+
+            def check_all(outs):
+                for out in outs or []:
+                    if (b := check(out)) is not None:
+                        return b
+                return None
+
             for ids, cols, mask in device_prefetch(batcher.epoch(),
                                                    shardings=batch_sh):
                 frac = total_rounds / max(spe, 1)
-                raw = learner.train_round_async(ids, cols, mask,
-                                                epoch_frac=frac)
-                total_rounds += 1
-                if bad := check(pipe.push(raw)):
-                    return learner, {"aborted": True, "loss": bad["loss"]}
+                if window is not None:
+                    total_rounds += 1
+                    if bad := check_all(window.push(ids, cols, mask, frac)):
+                        return learner, {"aborted": True,
+                                         "loss": bad["loss"]}
+                else:
+                    raw = learner.train_round_async(ids, cols, mask,
+                                                    epoch_frac=frac)
+                    total_rounds += 1
+                    if bad := check(pipe.push(raw)):
+                        return learner, {"aborted": True,
+                                         "loss": bad["loss"]}
                 if args.do_test or (max_rounds and total_rounds >= max_rounds):
                     break
-            if bad := check(pipe.flush()):
+            if bad := (check_all(window.flush()) if window is not None
+                       else check(pipe.flush())):
                 return learner, {"aborted": True, "loss": bad["loss"]}
             train_time = timer()
             val = learner.evaluate(val_batches(val_set,

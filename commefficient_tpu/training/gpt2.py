@@ -86,6 +86,9 @@ def train(args, mesh=None, max_rounds=None, log=True):
     gcfg.attn_impl = getattr(args, "attn_impl", "full")
     # bf16 matmuls (params and logits stay f32); reference default is f32
     gcfg.dtype = getattr(args, "compute_dtype", "float32")
+    # hardware-RNG dropout bits / fused LM-head CE (see args.py help)
+    gcfg.dropout_impl = getattr(args, "dropout_impl", "xla")
+    gcfg.fused_lm_head = bool(getattr(args, "fused_lm_head", False))
     seq_n = (mesh.shape["seq"]
              if mesh is not None and "seq" in mesh.axis_names else 1)
     if seq_n > 1:
@@ -250,17 +253,36 @@ def train(args, mesh=None, max_rounds=None, log=True):
             # next round's batch transfers while this one computes
             # (sharding-aware on a mesh: lands directly on the shards)
             from commefficient_tpu.data.prefetch import device_prefetch
+            # --scan_rounds K>1: K rounds per dispatch (api.ScanWindow;
+            # see training/cv.py for the convention)
+            scan_k = max(1, int(getattr(args, "scan_rounds", 1) or 1))
+            window = learner.scan_window(scan_k) if scan_k > 1 else None
+
+            def check_all(outs):
+                bad = False
+                for o in outs or []:
+                    bad = check(o) or bad
+                return bad
+
             for ids, cols, mask in device_prefetch(
                     batcher.epoch(), shardings=learner.batch_shardings):
-                raw = learner.train_round_async(ids, cols, mask,
-                                                epoch_frac=total_rounds)
-                total_rounds += 1
-                if check(pipe.push(raw)):
-                    print("NaN loss; aborting")
-                    return learner, {"aborted": True}
+                if window is not None:
+                    out_w = window.push(ids, cols, mask, total_rounds)
+                    total_rounds += 1
+                    if check_all(out_w):
+                        print("NaN loss; aborting")
+                        return learner, {"aborted": True}
+                else:
+                    raw = learner.train_round_async(ids, cols, mask,
+                                                    epoch_frac=total_rounds)
+                    total_rounds += 1
+                    if check(pipe.push(raw)):
+                        print("NaN loss; aborting")
+                        return learner, {"aborted": True}
                 if args.do_test or (max_rounds and total_rounds >= max_rounds):
                     break
-            if check(pipe.flush()):
+            if (check_all(window.flush()) if window is not None
+                    else check(pipe.flush())):
                 print("NaN loss; aborting")
                 return learner, {"aborted": True}
             train_time = timer()
@@ -302,7 +324,15 @@ def train(args, mesh=None, max_rounds=None, log=True):
             writer.close()
 
     if log and not args.do_test:
-        _print_sample(args, init_model, learner, tokenizer, val_set)
+        gen_model = init_model
+        if gcfg.fused_lm_head:
+            # generation needs real logits; params are identical, so
+            # sample through a non-fused twin of the same config
+            import copy
+            ncfg = copy.copy(init_model.config)
+            ncfg.fused_lm_head = False
+            gen_model = GPT2DoubleHeads(ncfg)
+        _print_sample(args, gen_model, learner, tokenizer, val_set)
     if args.do_checkpoint:
         save_pretrained(args.checkpoint_path, learner, gcfg, tokenizer)
     return learner, row
@@ -320,7 +350,7 @@ def _print_sample(args, init_model, learner, tokenizer, val_set):
         persona = tokenize_tree(d["personality"], tokenizer)
         history = tokenize_tree(
             utt["history"][-(2 * args.max_history + 1):], tokenizer)
-        reply = sample_reply(model, learner.params, tokenizer, persona,
+        reply = sample_reply(init_model, learner.params, tokenizer, persona,
                              history, max_seq_len=args.max_seq_len)
         print("context:", " / ".join(utt["history"][-2:]))
         print("sample reply:", tokenizer.decode(reply))

@@ -597,3 +597,44 @@ def test_fused_path_matches_per_worker_vmap(cfg_kw):
         assert a["num_datapoints"] == b["num_datapoints"]
         assert a["upload_bytes"] == b["upload_bytes"]
         assert a["download_bytes"] == b["download_bytes"]
+
+
+@pytest.mark.parametrize("cfg_kw", [
+    dict(mode="uncompressed", error_type="none", virtual_momentum=0.9),
+    dict(mode="sketch", error_type="virtual", virtual_momentum=0.9,
+         k=1, num_rows=3, num_cols=16),
+    dict(mode="local_topk", error_type="local", local_momentum=0.9,
+         virtual_momentum=0, k=1),
+])
+def test_rounds_scan_matches_sequential(cfg_kw):
+    """train_rounds_scan(K) must reproduce K train_round calls exactly:
+    same rng chain, same LR schedule points, same state, same metrics and
+    byte totals — one dispatch instead of K."""
+    cfg = FedConfig(num_workers=2, num_clients=4, lr_scale=0.02,
+                    weight_decay=0, local_momentum=cfg_kw.pop(
+                        "local_momentum", 0), **cfg_kw)
+    ids, batch, mask = two_worker_batch()
+    K = 4
+
+    ln_a = toy_learner(cfg, num_workers=2)
+    ln_b = toy_learner(cfg, num_workers=2)
+
+    outs_a = [ln_a.train_round(ids, batch, mask) for _ in range(K)]
+
+    ids_k = np.stack([np.asarray(ids)] * K)
+    cols_k = tuple(np.stack([np.asarray(c)] * K) for c in batch)
+    mask_k = np.stack([np.asarray(mask)] * K)
+    outs_b = ln_b.finalize_scan_metrics(
+        ln_b.train_rounds_scan(ids_k, cols_k, mask_k))
+
+    assert len(outs_b) == K
+    for a, b in zip(outs_a, outs_b):
+        np.testing.assert_allclose(b["loss"], a["loss"], rtol=1e-6)
+        assert b["upload_bytes"] == a["upload_bytes"]
+        assert b["download_bytes"] == a["download_bytes"]
+        assert b["lr"] == a["lr"]
+    assert ln_b.rounds_done == ln_a.rounds_done
+    assert ln_b.total_upload_bytes == ln_a.total_upload_bytes
+    assert ln_b.total_download_bytes == ln_a.total_download_bytes
+    np.testing.assert_array_equal(np.asarray(ln_a.state.weights),
+                                  np.asarray(ln_b.state.weights))
