@@ -319,14 +319,17 @@ def bench_longcontext_tokens():
     params = model.init(jax.random.PRNGKey(0), ids, types, mc,
                         train=False)["params"]
 
+    # labels shifted instead of slicing logits[:-1]: the sliced logits'
+    # backward would materialize a (B, T, V) 3.3 GB pad (losses.py note)
+    tgt = jnp.concatenate([labels[:, 0, 1:], labels[:, 0, :1]], axis=-1)
+
     @jax.jit
     def step(p):
         def loss_fn(p):
             lm, _ = model.apply({"params": p}, ids, types, mc, train=False)
-            lp = jax.nn.log_softmax(lm[:, 0, :-1].astype(jnp.float32))
-            tgt = labels[:, 0, 1:]
-            return -jnp.mean(jnp.take_along_axis(
-                lp, tgt[..., None], axis=-1))
+            lp = jax.nn.log_softmax(lm[:, 0].astype(jnp.float32))
+            picked = jnp.take_along_axis(lp, tgt[..., None], axis=-1)
+            return -jnp.mean(picked[:, :-1])
         return jax.grad(loss_fn)(p)
 
     # steady-state throughput, same convention as the federated metrics:

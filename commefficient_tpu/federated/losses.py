@@ -30,15 +30,33 @@ def make_cv_loss(model):
     return apply_loss
 
 
+def shift_labels(lm_labels):
+    """Next-token targets: shifted[t] = labels[t+1], final position -1
+    (ignored). The ONE shift convention shared by the dense losses here
+    and the sequence-parallel losses (parallel/seq.py)."""
+    return jnp.concatenate(
+        [lm_labels[..., 1:], jnp.full_like(lm_labels[..., :1], -1)],
+        axis=-1)
+
+
 def _lm_nll_sums(lm_logits, lm_labels):
     """(nll token-sum, labeled-token count) per dialog over shifted
     positions with label != -1 (ref CrossEntropyLoss(ignore_index=-1),
-    gpt2_train.py:77-87)."""
-    logits = lm_logits[..., :-1, :]
-    labels = lm_labels[..., 1:]
+    gpt2_train.py:77-87).
+
+    The shift is applied to the LABELS (``shift_labels``) rather than
+    slicing ``lm_logits[..., :-1, :]``: slicing the (.., T, V) logits
+    costs a full-tensor copy forward and — worse — XLA materializes the
+    sliced gradient back to (.., T, V) with a 3.3 GB `pad` in the
+    backward (round-4 HLO audit). Shifting the tiny int32 labels instead
+    is mathematically identical: position T-1 gets label -1 and is
+    masked like any other ignored position, so its dlogits row is
+    exactly zero.
+    """
+    labels = shift_labels(lm_labels)
     valid = labels != -1
     safe = jnp.where(valid, labels, 0)
-    nll = optax.softmax_cross_entropy_with_integer_labels(logits, safe)
+    nll = optax.softmax_cross_entropy_with_integer_labels(lm_logits, safe)
     nll = jnp.where(valid, nll, 0.0)
     return (jnp.sum(nll, axis=(-2, -1)),
             jnp.sum(valid, axis=(-2, -1)).astype(jnp.float32))

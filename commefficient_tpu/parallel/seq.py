@@ -78,11 +78,11 @@ def seq_parallel_apply(mesh, model, params, input_ids, token_type_ids,
 def _shift_labels(lm_labels):
     """Pre-shift next-token labels at GLOBAL shape so the shard-local CE
     never pairs a logit with a label owned by the next sequence shard:
-    shifted[t] = labels[t+1], last position -1 (ignored). Pairing logits
-    0..T-1 with shifted labels is exactly losses._lm_nll_sums' pairing of
-    logits[:-1] with labels[1:]."""
-    pad = jnp.full(lm_labels.shape[:-1] + (1,), -1, lm_labels.dtype)
-    return jnp.concatenate([lm_labels[..., 1:], pad], axis=-1)
+    the shared ``losses.shift_labels`` convention (which the dense
+    ``_lm_nll_sums`` also applies — both paths pair logits 0..T-1 with
+    shifted labels)."""
+    from commefficient_tpu.federated.losses import shift_labels
+    return shift_labels(lm_labels)
 
 
 def make_gpt2_train_loss_seq(mesh, model, lm_coef: float = 1.0,

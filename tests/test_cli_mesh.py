@@ -248,3 +248,18 @@ def test_cv_cli_scan_rounds_on_mesh_matches_per_round(tmp_path):
     np.testing.assert_allclose(w_scan, w_seq, atol=1e-6)
     assert row_scan["train_loss"] == pytest.approx(row_seq["train_loss"],
                                                    rel=1e-5)
+
+
+def test_gpt2_cli_scan_rounds_smoke(tmp_path, capsys):
+    # --scan_rounds through the gpt2 entrypoint (ScanWindow path with the
+    # gpt2 loop's abort bookkeeping), plus the xla_rbg dropout flag
+    from commefficient_tpu.training.gpt2 import main
+    rc = main(["--test", "--model", "gpt2-tiny",
+               "--dataset_name", "SyntheticPersona",
+               "--dataset_dir", str(tmp_path), "--max_seq_len", "32",
+               "--mode", "uncompressed", "--error_type", "none",
+               "--virtual_momentum", "0.9", "--num_workers", "2",
+               "--scan_rounds", "2", "--dropout_impl", "xla_rbg"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "final:" in out and "aborted" not in out
