@@ -460,6 +460,13 @@ def write_markdown(results: list, path: str = "RESULTS.md") -> None:
             lines += ["(lower nll is better; the synthetic MC candidates "
                       "carry no signal, so nll/ppl is the learnable "
                       "target — results.py docstring)", ""]
+        seed_rows = [r for r in rows if "_s" in r["mode"]
+                     and r["mode"].rsplit("_s", 1)[-1].isdigit()]
+        if seed_rows:
+            lines += ["`mode_sNN` rows re-run that mode at seed NN with "
+                      "an otherwise identical recipe (base rows are "
+                      "seed 21) — the seed-robustness evidence for this "
+                      "task.", ""]
         lines += [f"| mode | lr | {metric_hdr} | upload/client/round | "
                   "upload total | upload vs uncompressed | download total | "
                   "rounds | wall |",
