@@ -271,7 +271,7 @@ def bench_gpt2_tokens(attn_impl="full"):
     return scanned, per_dispatch
 
 
-def bench_gpt2_sketch_rounds(approx_recall=0.95):
+def bench_gpt2_sketch_rounds(approx_recall=0.95, per_dispatch=True):
     """FetchSGD on gpt2-small itself (d~124M) — the paper's NLP headline:
     5x500k sketch compresses the 474MB gradient to 9.5MB per client per
     round. One full federated sketch round on PersonaChat shapes.
@@ -285,8 +285,14 @@ def bench_gpt2_sketch_rounds(approx_recall=0.95):
     learner, one_round, _, (batch, mask, ids_fn) = _gpt2_fed_setup(
         B=4, mode="sketch", error_type="virtual", k=50_000, num_rows=5,
         num_cols=500_000, topk_approx_recall=approx_recall)
-    return 1.0 / _timed_scan_windows(learner, ids_fn, batch, mask,
-                                     n_rounds=6)
+    # BOTH measurement conventions (ADVICE r4): rounds 1-3 reported
+    # per-round dispatch; round 4 switched the headline to scan windows —
+    # emitting the per-dispatch companion keeps history comparable.
+    scanned = 1.0 / _timed_scan_windows(learner, ids_fn, batch, mask,
+                                        n_rounds=6)
+    if not per_dispatch:   # skip the extra compile + 3x6 timed rounds
+        return scanned, None
+    return scanned, 1.0 / _timed_windows(learner, one_round, n_rounds=6)
 
 
 def bench_longcontext_tokens():
@@ -363,8 +369,9 @@ def main():
         cifar_exact, _ = bench_cifar_sketch(approx_recall=0.0)
         gpt2_tokens, gpt2_tokens_pd = bench_gpt2_tokens()
         gpt2_tokens_flash, _ = bench_gpt2_tokens(attn_impl="blockwise")
-        gpt2_sketch = bench_gpt2_sketch_rounds()
-        gpt2_sketch_exact = bench_gpt2_sketch_rounds(approx_recall=0.0)
+        gpt2_sketch, gpt2_sketch_pd = bench_gpt2_sketch_rounds()
+        gpt2_sketch_exact, _ = bench_gpt2_sketch_rounds(approx_recall=0.0,
+                                                        per_dispatch=False)
         longctx_tokens = bench_longcontext_tokens()
 
     print(json.dumps({
@@ -403,7 +410,15 @@ def main():
             "metric": "gpt2_fetchsgd_sketch_rounds_per_sec",
             "value": round(gpt2_sketch, 4),
             "unit": "rounds/sec",
-            "config": {"topk_approx_recall": 0.95},
+            "config": {"topk_approx_recall": 0.95,
+                       "note": "train_rounds_scan windows (K=6)"},
+        }, {
+            "metric": "gpt2_fetchsgd_sketch_rounds_per_sec_per_round_dispatch",
+            "value": round(gpt2_sketch_pd, 4),
+            "unit": "rounds/sec",
+            "config": {"topk_approx_recall": 0.95,
+                       "note": "one host dispatch per round (rounds 1-3 "
+                               "measurement mode)"},
         }, {
             "metric": "gpt2_fetchsgd_sketch_rounds_per_sec_exact_topk",
             "value": round(gpt2_sketch_exact, 4),

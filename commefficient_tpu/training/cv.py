@@ -144,7 +144,16 @@ def train(args, mesh=None, max_rounds=None, log=True):
             writer.add_scalar("test_loss", val0["loss"], 0)
             writer.add_scalar("test_acc", float(val0["metrics"][0]), 0)
     try:
-        for epoch in range(int(math.ceil(args.num_epochs))):
+        n_epochs = int(math.ceil(args.num_epochs))
+        for epoch in range(n_epochs):
+            # fractional num_epochs truncates the LAST epoch's round count
+            # (ref cv_train.py:100-106, 194-196: only epoch_fraction of the
+            # final epoch's batches run); whole epochs run the full spe
+            epoch_fraction = (args.num_epochs - epoch
+                              if epoch == n_epochs - 1 else 1.0)
+            rounds_cap = (spe if epoch_fraction >= 1
+                          else max(1, int(round(spe * epoch_fraction))))
+            rounds_in_epoch = 0
             epoch_metrics = []
             # one-round software pipeline (RoundPipeline): metric sync
             # overlaps the next round's device compute, so the loop runs
@@ -163,11 +172,12 @@ def train(args, mesh=None, max_rounds=None, log=True):
                 # pipelined round AFTER the breach can report a healthy
                 # loss again (the guard froze the weights), so the latched
                 # flag is the only reliable signal
-                if out["aborted"]:
-                    print(f"NaN/divergent loss ({out['loss']}); aborting "
-                          f"(threshold {args.nan_threshold})")
-                    return out
-                return None
+                return out if out["aborted"] else None
+
+            def abort(bad):
+                print(f"NaN/divergent loss ({bad['loss']}); aborting "
+                      f"(threshold {args.nan_threshold})")
+                return learner, {"aborted": True, "loss": bad["loss"]}
 
             # next round's batch transfers while this one computes
             # (sharding-aware on a mesh: lands directly on the shards)
@@ -182,31 +192,34 @@ def train(args, mesh=None, max_rounds=None, log=True):
             window = learner.scan_window(scan_k) if scan_k > 1 else None
 
             def check_all(outs):
+                # record EVERY finalized round's metrics before reporting
+                # the first aborted one (gpt2.py's convention; ADVICE r4)
+                bad = None
                 for out in outs or []:
-                    if (b := check(out)) is not None:
-                        return b
-                return None
+                    bad = check(out) or bad
+                return bad
 
             for ids, cols, mask in device_prefetch(batcher.epoch(),
                                                    shardings=batch_sh):
                 frac = total_rounds / max(spe, 1)
                 if window is not None:
                     total_rounds += 1
+                    rounds_in_epoch += 1
                     if bad := check_all(window.push(ids, cols, mask, frac)):
-                        return learner, {"aborted": True,
-                                         "loss": bad["loss"]}
+                        return abort(bad)
                 else:
                     raw = learner.train_round_async(ids, cols, mask,
                                                     epoch_frac=frac)
                     total_rounds += 1
+                    rounds_in_epoch += 1
                     if bad := check(pipe.push(raw)):
-                        return learner, {"aborted": True,
-                                         "loss": bad["loss"]}
-                if args.do_test or (max_rounds and total_rounds >= max_rounds):
+                        return abort(bad)
+                if (args.do_test or rounds_in_epoch >= rounds_cap
+                        or (max_rounds and total_rounds >= max_rounds)):
                     break
             if bad := (check_all(window.flush()) if window is not None
                        else check(pipe.flush())):
-                return learner, {"aborted": True, "loss": bad["loss"]}
+                return abort(bad)
             train_time = timer()
             val = learner.evaluate(val_batches(val_set,
                                                args.valid_batch_size))

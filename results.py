@@ -64,6 +64,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import time
 
@@ -248,6 +249,138 @@ def run_grid(out: str = "RESULTS_grid", quick: bool = False) -> list:
     return rows
 
 
+# --- the round-5 persona_small tuning grid (VERDICT r4 Weak #1) -------------
+# The d=124M headline previously pinned uncompressed to lr=0.01 — the LR
+# tuned on gpt2-tiny (d~450k), never probed at this scale — with 2 seeds.
+# Probe each headline mode at LRs STRADDLING its inherited point, then give
+# the tuned-best 3 seeds, so the "sketch beats dense at 49.6x less upload"
+# claim meets the same tuned-grid standard patches32 does.
+GRID_SMALL_LRS = {
+    "uncompressed": ["0.005", "0.01", "0.02"],
+    "sketch": ["0.02", "0.04", "0.08"],
+}
+GRID_SMALL_SEEDS = ("21", "42", "77")
+
+
+def run_grid_small(out: str = "RESULTS_grid_small",
+                   quick: bool = False) -> list:
+    """Resumable persona_small (mode x lr x seed) tuning grid.
+
+    Incremental like ``run_grid``; existing RESULTS.json persona_small rows
+    at matching (mode, lr, seed) are imported instead of re-run (each run
+    costs 2-7 min of TPU)."""
+    if quick:
+        out = out + "_smoke"
+    path = f"{out}.json"
+    rows = []
+    if os.path.exists(path) and not quick:
+        with open(path) as f:
+            rows = json.load(f)["results"]
+    if not rows and os.path.exists("RESULTS.json") and not quick:
+        # seed the grid with the already-run persona_small evidence
+        with open("RESULTS.json") as f:
+            prior = json.load(f)["results"]
+        for r in prior:
+            if r["task"] != "persona_small" or r["aborted"]:
+                continue
+            base = r["mode"].split("_s")[0].split("_lr")[0]
+            if base not in GRID_SMALL_LRS:
+                continue
+            imported = dict(r)
+            imported.update(
+                mode=_grid_label(base, f"{r['lr']:g}", str(r["seed"])),
+                base_mode=base)
+            rows.append(imported)
+    done = {r["mode"] for r in rows}
+    grid_lrs = GRID_SMALL_LRS
+    seeds = GRID_SMALL_SEEDS
+    if quick:
+        grid_lrs = {m: lrs[:2] for m, lrs in GRID_SMALL_LRS.items()}
+        seeds = GRID_SMALL_SEEDS[:2]
+
+    def launch(mode, lr, seed, label):
+        if label in done:
+            return
+        r = run_one("persona_small", mode, quick,
+                    variant=(label, ["--lr_scale", lr, "--seed", seed]))
+        r.update(base_mode=mode, lr=float(lr), seed=int(seed))
+        rows.append(r)
+        done.add(label)
+        with open(path, "w") as f:
+            json.dump({"results": rows}, f, indent=1)
+
+    # stage A: LR probe at the base seed
+    for mode, lrs in grid_lrs.items():
+        for lr in lrs:
+            launch(mode, lr, seeds[0], _grid_label(mode, lr, seeds[0]))
+    # stage B: remaining seeds at each mode's tuned-best LR
+    for mode in grid_lrs:
+        lr = best_lr_small(rows, mode)
+        for seed in seeds[1:]:
+            launch(mode, lr, seed, _grid_label(mode, lr, seed))
+    return rows
+
+
+def best_lr_small(rows: list, mode: str) -> str:
+    """Tuned-best persona_small LR: lowest base-seed val nll, diverged
+    runs excluded."""
+    base_seed = int(GRID_SMALL_SEEDS[0])
+    cand = [(r["final_nll"], r["lr"]) for r in rows
+            if r.get("base_mode") == mode and r.get("seed") == base_seed
+            and not r["aborted"] and r.get("final_nll") is not None]
+    if not cand:
+        raise RuntimeError(f"no surviving grid_small rows for {mode}")
+    return f"{min(cand)[1]:g}"
+
+
+def tuned_rows_small(grid: list) -> list:
+    """One representative persona_small row per mode: the base-seed run at
+    the tuned-best LR, annotated with nll seed statistics."""
+    out = []
+    for mode in GRID_SMALL_LRS:
+        lr = float(best_lr_small(grid, mode))
+        seed_rows = [r for r in grid
+                     if r.get("base_mode") == mode and r.get("lr") == lr
+                     and not r["aborted"]]
+        nlls = [r["final_nll"] for r in seed_rows]
+        rep = dict(next(r for r in seed_rows
+                        if r["seed"] == int(GRID_SMALL_SEEDS[0])))
+        rep.update(mode=mode, nll_mean=float(np.mean(nlls)),
+                   nll_min=min(nlls), nll_max=max(nlls),
+                   n_seeds=len(seed_rows),
+                   n_diverged=len([r for r in grid
+                                   if r.get("base_mode") == mode
+                                   and r.get("lr") == lr and r["aborted"]]))
+        out.append(rep)
+    return out
+
+
+def write_grid_small_markdown(grid: list,
+                              path: str = "RESULTS_grid_small.md") -> None:
+    lines = [
+        "# Tuning grid — persona_small (gpt2-small, d=124,051,201)",
+        "",
+        "Every cell is a full 4-epoch federated run through the GPT2 "
+        "entrypoint at the reference's compression config (sketch 5x500k, "
+        "473.2 MiB dense upload). Stage A probes each mode's LR range at "
+        "seed 21 (straddling the LR previously inherited untuned from the "
+        "275x-smaller gpt2-tiny grid); stage B re-runs the tuned-best LR "
+        "on the remaining seeds. Lower nll is better.",
+        "",
+        "| mode | lr | seed | final val nll | ppl |",
+        "|---|---|---|---|---|",
+    ]
+    for r in sorted(grid, key=lambda r: (r["base_mode"], r["lr"],
+                                         r["seed"])):
+        cell = ("DIVERGED | —" if r["aborted"]
+                else f"{r['final_nll']:.4f} | {r['final_ppl']:.2f}")
+        lines.append(f"| {r['base_mode']} | {r['lr']:g} | {r['seed']} | "
+                     f"{cell} |")
+    lines.append("")
+    with open(path, "w") as f:
+        f.write("\n".join(lines))
+
+
 def best_lr(rows: list, mode: str) -> str:
     """Tuned-best LR for a mode: highest base-seed accuracy, diverged runs
     excluded (a diverging LR is outside the feasible set, not a 0-acc run)."""
@@ -427,6 +560,23 @@ def write_grid_markdown(grid: list, path: str = "RESULTS_grid.md") -> None:
         f.write("\n".join(lines))
 
 
+def fold_into_results(tuned: list, replaced) -> None:
+    """Replace the RESULTS.{json,md} rows matching ``replaced(row)`` with
+    tuned-grid rows and rewrite both artifacts together (shared by the
+    --grid and --grid_small folds)."""
+    results = []
+    if os.path.exists("RESULTS.json"):
+        with open("RESULTS.json") as f:
+            results = [r for r in json.load(f)["results"]
+                       if not replaced(r)]
+    results = results + tuned
+    task_idx = {"patches32": 0, "digits": 1, "persona": 2}
+    results.sort(key=lambda r: (task_idx.get(r["task"], 3), r["mode"]))
+    with open("RESULTS.json", "w") as f:
+        json.dump({"quick": False, "results": results}, f, indent=1)
+    write_markdown(results)
+
+
 def write_markdown(results: list, path: str = "RESULTS.md") -> None:
     lines = [
         "# RESULTS — accuracy vs. communication (real data, real runs)",
@@ -478,7 +628,13 @@ def write_markdown(results: list, path: str = "RESULTS.md") -> None:
                 lines.append(f"| {r['mode']} | {lr_cell} | {div} | — | — | "
                              f"— | — | {r['rounds']} | {r['wall_seconds']}s |")
                 continue
-            if persona:
+            if persona and "nll_mean" in r:
+                # tuned-grid row: seed mean with min-max spread
+                metric_cell = (f"{r['nll_mean']:.4f} "
+                               f"[{r['nll_min']:.4f}-{r['nll_max']:.4f}, "
+                               f"{r['n_seeds']} seeds] | "
+                               f"{math.exp(r['nll_mean']):.2f}")
+            elif persona:
                 metric_cell = f"{r['final_nll']:.4f} | {r['final_ppl']:.2f}"
             elif "acc_mean" in r:
                 # tuned-grid row: seed mean with min-max spread
@@ -519,11 +675,32 @@ def main():
                     help="run the patches32 LR x seed tuning grid + "
                          "local_topk diagnostics (resumable), then fold "
                          "tuned-best rows into RESULTS.{json,md}")
+    ap.add_argument("--grid_small", action="store_true",
+                    help="run the persona_small LR x seed tuning grid "
+                         "(resumable), then fold tuned-best rows into "
+                         "RESULTS.{json,md}")
     ap.add_argument("--out", default=None,
                     help="artifact basename (default RESULTS, or "
                          "RESULTS_smoke under --quick so a smoke run can "
                          "never clobber or leak into the real artifact)")
     args = ap.parse_args()
+    if args.grid_small:
+        grid = run_grid_small(quick=args.quick)
+        if args.quick:
+            write_grid_small_markdown(grid, "RESULTS_grid_small_smoke.md")
+            print(f"quick grid_small smoke done ({len(tuned_rows_small(grid))}"
+                  " tuned rows; real artifacts untouched)")
+            return
+        write_grid_small_markdown(grid)
+        # replace the persona_small headline rows in RESULTS with tuned rows
+        fold_into_results(
+            tuned_rows_small(grid),
+            lambda r: (r["task"] == "persona_small"
+                       and (r["mode"] in GRID_SMALL_LRS
+                            or r["mode"].split("_s")[0] in GRID_SMALL_LRS)))
+        print("wrote RESULTS_grid_small.{json,md} and folded tuned rows "
+              "into RESULTS.{json,md}")
+        return
     if args.grid:
         grid = run_grid(quick=args.quick)
         if args.quick:
@@ -535,18 +712,9 @@ def main():
             return
         write_grid_markdown(grid)
         # replace the patches32 base-mode rows in RESULTS with tuned rows
-        results = []
-        if os.path.exists("RESULTS.json"):
-            with open("RESULTS.json") as f:
-                results = [r for r in json.load(f)["results"]
-                           if not (r["task"] == "patches32"
-                                   and r["mode"] in MODES)]
-        results = tuned_rows(grid) + results
-        task_idx = {"patches32": 0, "digits": 1, "persona": 2}
-        results.sort(key=lambda r: (task_idx.get(r["task"], 3), r["mode"]))
-        with open("RESULTS.json", "w") as f:
-            json.dump({"quick": False, "results": results}, f, indent=1)
-        write_markdown(results)
+        fold_into_results(tuned_rows(grid),
+                          lambda r: (r["task"] == "patches32"
+                                     and r["mode"] in MODES))
         print("wrote RESULTS_grid.{json,md} and folded tuned rows into "
               "RESULTS.{json,md}")
         return

@@ -263,3 +263,26 @@ def test_loggers(capsys):
     timer = Timer()
     dt = timer()
     assert dt >= 0 and timer.total_time >= dt
+
+
+def test_fractional_final_epoch(tmp_path):
+    """Fractional --num_epochs truncates the LAST epoch's round count
+    (ref cv_train.py:100-106, 194-196), not just the LR schedule."""
+    from commefficient_tpu.data import FedBatcher
+    from commefficient_tpu.training.args import build_parser
+    from commefficient_tpu.training.cv import make_dataset, train
+
+    argv = ["--mode", "uncompressed", "--error_type", "none",
+            "--model", "TinyMLP",
+            "--dataset_name", "Digits", "--dataset_dir", str(tmp_path),
+            "--num_workers", "2", "--local_batch_size", "8",
+            "--valid_batch_size", "128", "--lr_scale", "0.01",
+            "--num_epochs", "1.5", "--seed", "3"]
+    args = build_parser().parse_args(argv)
+    train_set = make_dataset(args, train=True)
+    spe = FedBatcher(train_set, args.num_workers, args.local_batch_size,
+                     seed=args.seed).steps_per_epoch()
+    assert spe >= 2  # the truncation must be observable
+    learner, row = train(args, log=False)
+    assert row["epoch"] == 2
+    assert learner.rounds_done == spe + max(1, int(round(spe * 0.5)))
