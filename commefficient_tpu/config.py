@@ -75,6 +75,13 @@ class FedConfig:
     # federated dimensions
     num_clients: int = 10
     num_workers: int = 1  # clients sampled per round
+    # Host-offloaded client state: per-client velocity/error/weight rows
+    # live in TPU-host pinned memory (num_clients x d bounded by host RAM,
+    # not HBM — the reference's shm design, fed_aggregator.py:116-129, done
+    # TPU-natively); only the <=num_workers sampled rows move to device per
+    # round. Trajectory-identical to device-resident state
+    # (tests/test_offload.py); incompatible with --mesh and --scan_rounds.
+    client_state_offload: bool = False
     local_batch_size: int = 8  # -1 => each client's whole dataset per round
     valid_batch_size: int = 8
     microbatch_size: int = -1
@@ -173,6 +180,13 @@ class FedConfig:
         if self.mode == "sketch":
             return (self.num_rows, self.sketch_cols)
         return (self.grad_dim,)
+
+    @property
+    def has_client_state(self) -> bool:
+        """Whether the mode keeps any per-client persistent rows (the
+        only case where client_state_offload changes anything)."""
+        return (self.needs_velocity_state or self.needs_error_state
+                or self.needs_client_weights)
 
     @property
     def needs_velocity_state(self) -> bool:

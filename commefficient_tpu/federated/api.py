@@ -26,6 +26,7 @@ import numpy as np
 from commefficient_tpu.config import FedConfig
 from commefficient_tpu.federated.round import (
     FedState, build_eval_step, build_round_step, init_fed_state)
+from commefficient_tpu.federated.state import ClientState
 from commefficient_tpu.utils.params import flatten_params
 from commefficient_tpu.utils.schedules import PiecewiseLinear
 
@@ -63,6 +64,16 @@ class FedLearner:
         self.unflatten = unflatten
         self.mesh = mesh
         self.state: FedState = init_fed_state(self.cfg, flat)
+        # Host-offloaded client state (cfg.client_state_offload): the
+        # (num_clients, d) momentum/error/weight rows live in TPU-host
+        # pinned memory — bounded by host RAM like the reference's shm
+        # design (fed_aggregator.py:116-129) — and only the sampled rows
+        # move to device each round (round.build_round_step offload path).
+        self._offload = (self.cfg.client_state_offload
+                         and self.cfg.has_client_state)
+        self.host_clients = None
+        if self._offload:
+            self._init_host_rows(flat)
         if mesh is not None:
             from commefficient_tpu.parallel.mesh import (batch_shardings,
                                                          shard_state)
@@ -117,6 +128,71 @@ class FedLearner:
         self.total_download_bytes = 0.0
         self.total_upload_bytes = 0.0
 
+    def _init_host_rows(self, flat):
+        """Allocate per-client state rows host-side: pinned_host memory
+        when the backend supports it (TPU-host RAM — zero tunnel traffic
+        on remote chips; XLA's transfer engine streams rows over PCIe),
+        else plain numpy."""
+        from jax.sharding import SingleDeviceSharding
+        dev = jax.devices()[0]
+        d = self.cfg.grad_dim
+        try:
+            self._s_dev = SingleDeviceSharding(dev)
+            self._s_host = SingleDeviceSharding(dev,
+                                                memory_kind="pinned_host")
+            jax.device_put(jnp.zeros((1,)), self._s_host)  # probe support
+            zero_dev = jnp.zeros((d,), jnp.float32)
+            # each device_put materializes a DISTINCT host buffer (rows
+            # evolve independently)
+            zero = lambda: jax.device_put(zero_dev, self._s_host)  # noqa
+        except Exception:
+            self._s_host = None
+            zero = lambda: np.zeros((d,), np.float32)  # noqa: E731
+        n = self.cfg.num_clients
+        self.host_clients = {
+            "velocities": ([zero() for _ in range(n)]
+                           if self.cfg.needs_velocity_state else None),
+            "errors": ([zero() for _ in range(n)]
+                       if self.cfg.needs_error_state else None),
+            # topk_down stale weights start as copies of the init weights
+            "weights": ([self._to_host(flat) for _ in range(n)]
+                        if self.cfg.needs_client_weights else None),
+        }
+
+    def _to_host(self, x):
+        if self._s_host is not None:
+            return jax.device_put(x, self._s_host)
+        return np.asarray(x)
+
+    def _gather_host(self, field, ids_np):
+        """Stack the sampled clients' host rows into a (W, d) device
+        array. Out-of-range ids (padded epoch-tail slots) clamp like the
+        device gather would; their rows are inert (zero mask)."""
+        lst = self.host_clients[field]
+        if lst is None:
+            return None
+        n = len(lst)
+        picked = [lst[int(np.clip(i, 0, n - 1))] for i in ids_np]
+        if self._s_host is not None:
+            picked = [jax.device_put(r, self._s_dev) for r in picked]
+        return jnp.stack(picked)
+
+    def _scatter_host(self, ids_np, valid, out_rows):
+        """Write the round's output rows back to host memory. The round
+        returns the INPUT row for aborted/invalid slots, so writes are
+        value-correct unconditionally; invalid (padded) slots are still
+        skipped so a padded id-0 slot can never clobber a real client-0
+        update in the same round."""
+        for field, new in (("velocities", out_rows.velocities),
+                           ("errors", out_rows.errors),
+                           ("weights", out_rows.weights)):
+            lst = self.host_clients[field]
+            if lst is None or new is None:
+                continue
+            for w, cid in enumerate(ids_np):
+                if valid[w] and 0 <= cid < len(lst):
+                    lst[int(cid)] = self._to_host(new[w])
+
     @property
     def batch_shardings(self):
         """Per-round batch shardings on the mesh (None off-mesh) — for
@@ -153,8 +229,19 @@ class FedLearner:
             cols = jax.device_put(cols, cols_sh)
             m = jax.device_put(m, mask_sh)
         lr_in = lr if self.lr_scale_vec is None else lr * self.lr_scale_vec
-        self.state, metrics = self._round(self.state, ids, cols, m,
-                                          lr_in, round_rng)
+        if self._offload:
+            ids_np = np.asarray(client_ids).astype(np.int64)
+            valid = np.asarray(mask).any(axis=1)
+            rows = ClientState(
+                velocities=self._gather_host("velocities", ids_np),
+                errors=self._gather_host("errors", ids_np),
+                weights=self._gather_host("weights", ids_np))
+            self.state, out_rows, metrics = self._round(
+                self.state, rows, ids, cols, m, lr_in, round_rng)
+            self._scatter_host(ids_np, valid, out_rows)
+        else:
+            self.state, metrics = self._round(self.state, ids, cols, m,
+                                              lr_in, round_rng)
         self.rounds_done += 1
         metrics["lr"] = lr
         return metrics
@@ -164,6 +251,12 @@ class FedLearner:
         (mirrors run_batches, reference cv_train.py:171-252). Byte totals
         accumulate here, so a loop must finalize every round's metrics
         (in any order) for ``total_{down,up}load_bytes`` to be complete."""
+        if "lr" not in raw:
+            raise ValueError("round metrics were already finalized "
+                             "(finalize_* consumes its input)")
+        if isinstance(raw["lr"], list):
+            raise TypeError("this is a train_rounds_scan result; use "
+                            "finalize_scan_metrics")
         lr = raw.pop("lr")
         out = jax.device_get(raw)
         n = max(float(out["num_datapoints"]), 1.0)
@@ -233,6 +326,11 @@ class FedLearner:
         schedule, evaluated at ``rounds_done + k`` (or ``epoch_fracs``
         (K,)). Returns raw stacked metrics for
         ``finalize_scan_metrics``."""
+        if self._offload:
+            raise ValueError(
+                "train_rounds_scan needs device-resident client state "
+                "(offloaded rows are host-gathered per round); run with "
+                "scan_rounds=1 under client_state_offload")
         ids = jnp.asarray(client_ids, jnp.int32)
         K = ids.shape[0]
         ts = (np.asarray(epoch_fracs, np.float64) if epoch_fracs is not None
@@ -263,6 +361,12 @@ class FedLearner:
         """Block on a train_rounds_scan result: returns a list of K
         per-round dicts (same schema as finalize_round_metrics) and
         accumulates the byte totals."""
+        if "lr" not in raw:
+            raise ValueError("scan metrics were already finalized "
+                             "(finalize_* consumes its input)")
+        if not isinstance(raw["lr"], list):
+            raise TypeError("this is a single-round result; use "
+                            "finalize_round_metrics")
         lrs = raw.pop("lr")
         out = jax.device_get(raw)
         K = len(lrs)
@@ -290,6 +394,11 @@ class FedLearner:
 
     def scan_window(self, k: int) -> "ScanWindow":
         """A K-round scan buffer over this learner (see ``ScanWindow``)."""
+        if self._offload:
+            raise ValueError(
+                "--scan_rounds K>1 is incompatible with "
+                "--client_state_offload (rows are host-gathered per "
+                "round); use scan_rounds=1")
         return ScanWindow(self, k)
 
     def evaluate(self, batches: Iterable):

@@ -83,6 +83,13 @@ def build_parser(default_lr: float = 0.4) -> argparse.ArgumentParser:
     p.add_argument("--valid_batch_size", type=int, default=8)
     p.add_argument("--microbatch_size", type=int, default=-1)
     p.add_argument("--iid", action="store_true", dest="do_iid")
+    p.add_argument("--client_state_offload", action="store_true",
+                   help="keep per-client momentum/error/weight rows in "
+                        "TPU-host pinned memory (bounded by host RAM, not "
+                        "HBM — the reference's shm design done TPU-"
+                        "natively); only the sampled rows move to device "
+                        "each round. Trajectory-identical; needed for "
+                        "local_topk at gpt2-small scale on one chip")
     p.add_argument("--mesh", type=str, default="",
                    help="mesh shape as 'clients=N[,seq=M]' or 'clients=all';"
                         " empty = single-device (no mesh). See parse_mesh")

@@ -45,6 +45,14 @@ def save_checkpoint(path: str, learner, name: str = "model",
     # storing the dominant array twice)
     widx = next(i for i, x in enumerate(flat) if x is learner.state.weights)
     extra = {"meta": np.asarray(json.dumps(meta))} if meta else {}
+    # host-offloaded client state (api.FedLearner.host_clients) is not in
+    # the state pytree; persist the rows under host_{field} keys
+    host = getattr(learner, "host_clients", None)
+    if host:
+        for field, lst in host.items():
+            if lst is not None:
+                extra[f"host_{field}"] = np.stack(
+                    [np.asarray(x) for x in lst])
     np.savez(fn, rounds_done=learner.rounds_done,
              total_download_bytes=learner.total_download_bytes,
              total_upload_bytes=learner.total_upload_bytes,
@@ -101,6 +109,25 @@ def load_checkpoint(fn: str, learner) -> None:
                     f"model/config mismatch")
         learner.state = jax.tree_util.tree_unflatten(
             treedef, [jax.numpy.asarray(x) for x in restored])
+        host = getattr(learner, "host_clients", None)
+        if host:
+            for field, lst in host.items():
+                if lst is None:
+                    continue
+                key = f"host_{field}"
+                if key not in z.files:
+                    raise ValueError(
+                        f"checkpoint {fn} is missing offloaded client "
+                        f"rows {key!r} — it was saved without "
+                        f"client_state_offload (config mismatch)")
+                arr = z[key]
+                want = (len(lst),) + tuple(np.shape(lst[0]))
+                if tuple(arr.shape) != want:
+                    raise ValueError(
+                        f"checkpoint {fn} {key} has shape {arr.shape}, "
+                        f"learner expects {want} — config mismatch")
+                for i in range(len(lst)):
+                    lst[i] = learner._to_host(arr[i])
         learner.rounds_done = int(z["rounds_done"])
         learner.total_download_bytes = float(z["total_download_bytes"])
         learner.total_upload_bytes = float(z["total_upload_bytes"])

@@ -638,3 +638,30 @@ def test_rounds_scan_matches_sequential(cfg_kw):
     assert ln_b.total_download_bytes == ln_a.total_download_bytes
     np.testing.assert_array_equal(np.asarray(ln_a.state.weights),
                                   np.asarray(ln_b.state.weights))
+
+
+def test_finalize_wrong_variant_and_double_finalize_error_clearly():
+    """finalize_round_metrics vs finalize_scan_metrics mix-ups and
+    double-finalization fail with explicit messages, not an opaque
+    KeyError/TypeError (ADVICE r4: api.py lr bookkeeping)."""
+    cfg = FedConfig(mode="uncompressed", error_type="none", num_workers=1,
+                    num_clients=2, lr_scale=0.02, weight_decay=0)
+    ids, batch, mask = one_worker_batch()
+    ln = toy_learner(cfg)
+
+    raw = ln.train_round_async(ids, batch, mask)
+    with pytest.raises(TypeError, match="finalize_round_metrics"):
+        ln.finalize_scan_metrics(dict(raw))
+    ln.finalize_round_metrics(raw)
+    with pytest.raises(ValueError, match="already finalized"):
+        ln.finalize_round_metrics(raw)
+
+    ids_k = np.stack([np.asarray(ids)] * 2)
+    cols_k = tuple(np.stack([np.asarray(c)] * 2) for c in batch)
+    mask_k = np.stack([np.asarray(mask)] * 2)
+    raw_k = ln.train_rounds_scan(ids_k, cols_k, mask_k)
+    with pytest.raises(TypeError, match="finalize_scan_metrics"):
+        ln.finalize_round_metrics(dict(raw_k))
+    ln.finalize_scan_metrics(raw_k)
+    with pytest.raises(ValueError, match="already finalized"):
+        ln.finalize_scan_metrics(raw_k)
