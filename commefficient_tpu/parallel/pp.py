@@ -59,7 +59,7 @@ def stack_block_params(params, n_layer: int):
 
 def gpt2_pp_lm_apply(mesh, model, params, input_ids, token_type_ids,
                      n_micro: int, *, axis_name: str = "stage",
-                     train: bool = True, rngs=None):
+                     dp_axis: str = None, train: bool = True, rngs=None):
     """LM logits via a GPipe pipeline over ``axis_name``.
 
     ``input_ids``/``token_type_ids`` are (B, T) with B divisible by
@@ -75,6 +75,12 @@ def gpt2_pp_lm_apply(mesh, model, params, input_ids, token_type_ids,
     cfg.dropout > 0 but NO rngs still raises — silently dropping the
     configured regularization cannot be detected from outside. Inference
     with a dropout-configured model is fine: pass ``train=False``.
+
+    ``dp_axis``: optional SECOND mesh axis to shard batch rows over —
+    data parallelism outside, pipeline inside (each dp shard runs its own
+    GPipe ring over its B/n_dp rows). This is how ``--mesh
+    clients=N,stage=S`` composes with the federated round
+    (make_gpt2_train_loss_pp).
     """
     cfg: GPT2Config = model.config
     if cfg.attn_impl == "ring":
@@ -92,10 +98,16 @@ def gpt2_pp_lm_apply(mesh, model, params, input_ids, token_type_ids,
     if L % S:
         raise ValueError(f"n_layer ({L}) must divide by stages ({S})")
     B, T = input_ids.shape
-    if B % n_micro:
-        raise ValueError(f"batch ({B}) must divide by n_micro ({n_micro})")
+    n_dp = mesh.shape[dp_axis] if dp_axis else 1
+    if B % n_dp:
+        raise ValueError(f"batch ({B}) must divide by the {dp_axis} axis "
+                         f"({n_dp})")
+    B_local = B // n_dp           # rows each dp shard pipelines
+    if B_local % n_micro:
+        raise ValueError(f"per-shard batch ({B_local}) must divide by "
+                         f"n_micro ({n_micro})")
     per_stage = L // S
-    mb = B // n_micro
+    mb = B_local // n_micro
 
     stacked, rest = stack_block_params(params, L)
     # (S, per_stage, ...) — stage axis sharded, layer-within-stage local
@@ -108,7 +120,7 @@ def gpt2_pp_lm_apply(mesh, model, params, input_ids, token_type_ids,
                  cfg.moe_capacity_factor, cfg.remat,
                  cfg.dropout if dropout_on else 0.0, post_ln)
     pipe = _build_pipe(mesh, axis_name, block_key, S, per_stage,
-                       B, T, n_micro, mb)
+                       B_local, T, n_micro, mb, dp_axis)
 
     wte = params["wte"]["embedding"]
     wpe = params["wpe"]["embedding"]
@@ -127,7 +139,7 @@ def gpt2_pp_lm_apply(mesh, model, params, input_ids, token_type_ids,
 
 @lru_cache(maxsize=32)
 def _build_pipe(mesh, axis_name, block_key, S, per_stage, B, T, n_micro,
-                mb):
+                mb, dp_axis=None):
     """Jitted pipeline schedule, cached so repeated calls (a training
     loop's every step) reuse the compiled program. Cache key = everything
     the trace depends on; jax.Mesh is hashable."""
@@ -159,11 +171,18 @@ def _build_pipe(mesh, axis_name, block_key, S, per_stage, B, T, n_micro,
             body, x, (stage_params, jnp.arange(per_stage)))
         return h
 
+    data_spec = P(dp_axis) if dp_axis else P()
+
     @partial(shard_map, mesh=mesh,
-             in_specs=(P(axis_name), P(), P(), P(), P()),
-             out_specs=P(), check_vma=False)
+             in_specs=(P(axis_name), data_spec, data_spec, P(), P()),
+             out_specs=data_spec, check_vma=False)
     def pipe(stage_params, ids, types, pos_embed_inputs, base_key):
         my = jax.lax.axis_index(axis_name)
+        if dp_axis is not None:
+            # decorrelate dropout masks across data-parallel shards (the
+            # same fold parallel/seq._shard_rngs applies)
+            base_key = jax.random.fold_in(
+                base_key, jax.lax.axis_index(dp_axis))
         # local stage params: (1, per_stage, ...) -> (per_stage, ...)
         local = jax.tree_util.tree_map(lambda leaf: leaf[0], stage_params)
 
@@ -217,3 +236,39 @@ def _build_pipe(mesh, axis_name, block_key, S, per_stage, B, T, n_micro,
         return outs.reshape(B, T, C)
 
     return jax.jit(pipe)
+
+
+def make_gpt2_train_loss_pp(mesh, model, n_micro: int, lm_coef: float = 1.0,
+                            dp_axis: str = "clients",
+                            axis_name: str = "stage"):
+    """Pipeline-parallel GPT2 LM federated loss (same contract as
+    losses.make_gpt2_train_loss): batch rows shard over ``dp_axis``, the
+    transformer trunk runs as a GPipe pipeline over ``axis_name``. This is
+    how ``--mesh clients=N,stage=S`` composes with the federated round:
+    the round's fused-clients path calls this loss ONCE on the flattened
+    (W*B, C, T) batch, so the pipeline's shard_map nests under jit exactly
+    like the seq composition (parallel/seq.make_gpt2_train_loss_seq);
+    modes needing per-worker state are rejected at the entrypoint.
+
+    LM-only by design: the double-heads MC pick is out of the pipeline's
+    scope (module docstring), so the entrypoint requires ``--mc_coef 0``
+    — a loud contract, never a silently-dropped loss term. Gradients flow
+    through the fori_loop/ppermute schedule (ppermute's transpose is the
+    reverse permute); equivalence with the unsharded trajectory is
+    asserted in tests/test_cli_mesh.py.
+    """
+
+    def apply_loss(params, batch, rng, train):
+        from commefficient_tpu.federated.losses import _lm_nll_per_example
+        input_ids, mc_token_ids, lm_labels, mc_labels, token_type_ids = batch
+        B, C, T = input_ids.shape
+        logits = gpt2_pp_lm_apply(
+            mesh, model, params,
+            input_ids.reshape(B * C, T), token_type_ids.reshape(B * C, T),
+            n_micro, axis_name=axis_name, dp_axis=dp_axis, train=train,
+            rngs={"dropout": rng} if train else None)
+        lm = logits.reshape(B, C, T, -1)
+        loss = lm_coef * _lm_nll_per_example(lm, lm_labels)
+        return loss, jnp.zeros((1, B))
+
+    return apply_loss

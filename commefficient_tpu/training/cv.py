@@ -108,6 +108,12 @@ def train(args, mesh=None, max_rounds=None, log=True):
         raise ValueError("--mesh model=M (2D clients x model federation) "
                          "is wired for the gpt2 entrypoint; CV models "
                          "have no TP layout")
+    if mesh is not None and mesh.shape.get("stage", 1) > 1:
+        # the GPipe pipeline stacks homogeneous transformer blocks
+        # (parallel/pp.py); CV models have no such trunk
+        raise ValueError("--mesh stage=S (GPipe pipeline) is wired for "
+                         "the gpt2 entrypoint; CV models have no stacked "
+                         "block trunk")
     train_set = make_dataset(args, train=True)
     val_set = make_dataset(args, train=False)
     args.num_clients = train_set.num_clients
