@@ -10,6 +10,12 @@
 #   --mesh clients=8                  client-sharded data parallelism
 #   --mesh clients=4,seq=2            + sequence-parallel ring attention
 #   --mesh clients=2,model=4          + Megatron-TP sharded params
+#   --mesh clients=2,stage=4 --mc_coef 0   + GPipe pipeline (LM-only)
+#
+# Single-chip at capacity: --mode local_topk --error_type local
+#   --local_momentum 0.9 --client_state_offload parks the 2 x clients x
+#   124M floats of per-client state in TPU-host pinned memory (the
+#   reference's shm capacity model) and streams sampled rows per round.
 set -euo pipefail
 
 DATASET_DIR="${DATASET_DIR:-./dataset/persona}"
