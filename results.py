@@ -719,7 +719,10 @@ def write_grid_markdown(grid: list, path: str = "RESULTS_grid.md") -> None:
                   "clients/round at fixed epochs means 4x fewer rounds "
                   "and LR-schedule updates (rounds column in the JSON), "
                   "so its low score measures an undertrained schedule, "
-                  "not participation itself.", "",
+                  "not participation itself — the fixed-ROUND-budget "
+                  "participation comparison lives in RESULTS_regime.md "
+                  "(results.py --regime), which isolates the axis "
+                  "properly.", "",
                   "| variant | final val acc | upload/client/round |",
                   "|---|---|---|"]
         for r in diag:

@@ -121,7 +121,7 @@ def test_gpt2_seq_mesh_rejects_incompatible_modes(tmp_path):
          "--max_seq_len", "32", "--dataset_name", "SyntheticPersona",
          "--dataset_dir", str(tmp_path / "d2")])
     mesh = parse_mesh("clients=4,seq=2")
-    with pytest.raises(ValueError, match="seq>1 requires the fused"):
+    with pytest.raises(ValueError, match="seq=2 requires the fused"):
         train(args, mesh=mesh, log=False)
 
 
