@@ -140,9 +140,17 @@ class CausalSelfAttention(nn.Module):
         else:
             att = (jnp.einsum("bqhd,bkhd->bhqk", q, k)
                    / np.sqrt(C // self.n_head))
+            # ADDITIVE causal bias, not jnp.where(mask, att, min): an
+            # add's backward is identity where a select's is another
+            # (B,H,T,T) select. Measured speed-NEUTRAL (deterministic
+            # device A/B, docs/ROOFLINE.md r5 — XLA already fused the
+            # select); kept for the simpler backward. Identical math:
+            # |att| << |finfo.min|, so the sum rounds to exactly
+            # finfo.min and softmax still zeroes the masked positions
+            # (HF logit parity tested).
             causal = jnp.tril(jnp.ones((T, T), bool))
-            att = jnp.where(causal[None, None], att,
-                            jnp.finfo(att.dtype).min)
+            att = att + jnp.where(causal, 0.0,
+                                  jnp.finfo(att.dtype).min)[None, None]
             att = jax.nn.softmax(att, axis=-1)
             att = FusedDropout(self.dropout, self.dropout_impl)(
                 att, deterministic=not train)

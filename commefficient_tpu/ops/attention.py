@@ -56,11 +56,12 @@ def full_attention(q, k, v, *, causal: bool = True,
                    preferred_element_type=jnp.float32) / np.sqrt(D)
     if causal:
         # ADDITIVE bias, not jnp.where(mask, s, _NEG): the select's
-        # backward is another (B, H, T, T) select (ds where-zeroed), while
-        # an add's backward is identity — the mask constant-folds and the
-        # backward select disappears (~4 ms/round at the federated GPT2
-        # bench shape). Identical math: |s| << |_NEG|, so s + _NEG is
-        # -1e30 in f32 (absorbed) and exp()==0 exactly, and masked
+        # backward is another (B, H, T, T) select (ds where-zeroed), an
+        # add's backward is identity. Measured speed-NEUTRAL on the
+        # deterministic device A/B (docs/ROOFLINE.md r5 — XLA already
+        # fuses the select into the bandwidth-bound softmax chain); kept
+        # as the simpler form. Identical math: |s| << |_NEG|, so s + _NEG
+        # is -1e30 in f32 (absorbed) and exp()==0 exactly, and masked
         # positions get p == 0 so no gradient flows to them either way.
         qp = jnp.arange(Tq)[:, None]
         kp = jnp.arange(Tk)[None, :]
@@ -69,6 +70,15 @@ def full_attention(q, k, v, *, causal: bool = True,
         s = s + jnp.where(kv_mask[:, None, None, :], 0.0, _NEG)
     p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
     out = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    if causal and kv_mask is None and Tq == Tk:
+        # causal self-attention can have no fully-masked query row
+        # (position q always attends to itself), so the any_valid
+        # correction below is an identity — skipping it drops a
+        # (B,H,T,T) compare-reduce and a (B,T,H,D) select from the
+        # trace. (This function is the ops-level correctness reference
+        # used by the tests/seq paths; the GPT2 'full' bench path is the
+        # inline attention in models/gpt2.py.)
+        return out
     # fully-masked queries emit 0 (softmax of an all-masked row would
     # produce a meaningless uniform average) — the same convention the
     # online-softmax impls use
