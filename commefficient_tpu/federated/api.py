@@ -80,14 +80,14 @@ class FedLearner:
             self.state = shard_state(self.state, self.cfg, mesh)
             self._batch_sh = batch_shardings(mesh)
         round_unflatten = unflatten
-        if (mesh is not None and param_specs is not None
-                and "model" in mesh.axis_names):
-            # 2D clients x model federation: the flat weight vector is
-            # STORED coordinate-split over the model axis
-            # (parallel/mesh.fed_state_shardings), but the model should
-            # COMPUTE in its tensor-parallel layout (e.g. parallel/tp.py's
-            # Megatron specs). Re-constrain each unflattened leaf so GSPMD
-            # resharding happens once per round, then the matmuls run TP.
+        if mesh is not None and param_specs is not None:
+            # Inner-axis model layouts: the flat weight vector is STORED
+            # per fed_state_shardings (coordinate-split over a 'model'
+            # axis; replicated otherwise), but the model should COMPUTE
+            # in its parallel layout — parallel/tp.py's Megatron specs on
+            # a 'model' axis, ops/moe.moe_ep_specs on an 'expert' axis.
+            # Re-constrain each unflattened leaf so GSPMD resharding
+            # happens once per round, then the matmuls run in layout.
             from jax.sharding import NamedSharding
             from jax.sharding import PartitionSpec as _P
 

@@ -95,16 +95,38 @@ def _fused_nll_sums(model, hidden, params, lm_labels):
     return jnp.sum(nll_sum, axis=-1), jnp.sum(tokens, axis=-1)
 
 
-def make_gpt2_train_loss(model, lm_coef: float = 1.0, mc_coef: float = 1.0):
+def make_gpt2_train_loss(model, lm_coef: float = 1.0, mc_coef: float = 1.0,
+                         moe_aux_weight: float = 1e-2):
     """LM + multiple-choice loss (reference compute_loss_train,
-    gpt2_train.py:88-99)."""
+    gpt2_train.py:88-99). With an MoE-configured model
+    (config.moe_experts > 0) the Switch load-balancing auxiliary loss —
+    sown per block (ops/moe.py) — is averaged over layers and added at
+    ``moe_aux_weight``; without it, routing collapses onto one expert."""
     fused = _fused_lm_head(model)
+    moe = getattr(getattr(model, "config", None), "moe_experts", 0) > 0
 
     def apply_loss(params, batch, rng, train):
         input_ids, mc_token_ids, lm_labels, mc_labels, token_type_ids = batch
-        lm_out, mc_logits = model.apply(
-            {"params": params}, input_ids, token_type_ids, mc_token_ids,
-            train=train, rngs={"dropout": rng} if train else None)
+        rngs = {"dropout": rng} if train else None
+        if moe:
+            (lm_out, mc_logits), inter = model.apply(
+                {"params": params}, input_ids, token_type_ids,
+                mc_token_ids, train=train, rngs=rngs,
+                mutable=["intermediates"])
+            # select ONLY the moe_aux_loss sows by key path: any other
+            # sown intermediate (a metric, a debug stat) must not leak
+            # into the objective (code review r5)
+            aux_leaves = [
+                leaf for path, leaf in
+                jax.tree_util.tree_flatten_with_path(
+                    inter["intermediates"])[0]
+                if any("moe_aux_loss" in getattr(p, "key", str(p))
+                       for p in path)]
+            aux = sum(aux_leaves) / max(len(aux_leaves), 1)
+        else:
+            lm_out, mc_logits = model.apply(
+                {"params": params}, input_ids, token_type_ids,
+                mc_token_ids, train=train, rngs=rngs)
         if fused:
             nll_sum, tokens = _fused_nll_sums(model, lm_out, params,
                                               lm_labels)
@@ -114,6 +136,11 @@ def make_gpt2_train_loss(model, lm_coef: float = 1.0, mc_coef: float = 1.0):
         mc_loss = optax.softmax_cross_entropy_with_integer_labels(
             mc_logits, mc_labels)
         loss = lm_coef * lm_loss + mc_coef * mc_loss
+        if moe:
+            # scalar aux added to every per-example entry: the masked
+            # round's datapoint-weighted mean then recovers exactly
+            # moe_aux_weight * aux
+            loss = loss + moe_aux_weight * aux
         return loss, jnp.zeros((1, loss.shape[0]))
 
     return apply_loss

@@ -50,16 +50,18 @@ def padded_num_clients(num_clients: int, mesh: Optional[Mesh],
 
 
 def make_mesh(n_devices: Optional[int] = None, axis: str = "clients",
-              seq: int = 1, model: int = 1, stage: int = 1) -> Mesh:
+              seq: int = 1, model: int = 1, stage: int = 1,
+              expert: int = 1) -> Mesh:
     devs = jax.devices()
     n = n_devices or len(devs)
     if n > len(devs):
         raise ValueError(f"asked for {n} devices, have {len(devs)}")
-    if sum(s > 1 for s in (seq, model, stage)) > 1:
+    if sum(s > 1 for s in (seq, model, stage, expert)) > 1:
         raise ValueError("choose ONE inner axis: seq (ring attention), "
-                         "model (tensor parallelism), or stage (GPipe "
-                         "pipeline)")
-    for name, size in (("seq", seq), ("model", model), ("stage", stage)):
+                         "model (tensor parallelism), stage (GPipe "
+                         "pipeline), or expert (MoE expert parallelism)")
+    for name, size in (("seq", seq), ("model", model), ("stage", stage),
+                       ("expert", expert)):
         if size > 1:
             if n % size:
                 raise ValueError(f"n_devices must be divisible by {name}")

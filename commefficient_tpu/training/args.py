@@ -141,16 +141,18 @@ def args_to_config(args, **overrides) -> FedConfig:
 def parse_mesh(spec: str):
     """``--mesh`` string -> ``jax.sharding.Mesh`` (or None for no mesh).
 
-    Grammar: ``clients=N[,seq=M | ,model=M | ,stage=S]`` — the TPU analog
-    of the reference's process-topology flags (num_devices/share_ps_gpu,
-    ref utils.py:175). ``seq`` shards the sequence (ring attention, gpt2
-    entrypoint); ``model`` coordinate-splits weights and client state for
-    2D clients x model federation (the capability the reference buys with
-    a whole GPU per client, fed_worker.py:18-20); ``stage`` runs the
-    client loss through the GPipe pipeline (parallel/pp.py, gpt2
-    entrypoint, LM-only). The inner axes are mutually exclusive
-    (make_mesh). ``clients=all`` (or ``auto``) uses every visible device.
-    The mesh is built over the first N*M of ``jax.devices()``.
+    Grammar: ``clients=N[,seq=M | ,model=M | ,stage=S | ,expert=E]`` —
+    the TPU analog of the reference's process-topology flags
+    (num_devices/share_ps_gpu, ref utils.py:175). ``seq`` shards the
+    sequence (ring attention, gpt2 entrypoint); ``model``
+    coordinate-splits weights and client state for 2D clients x model
+    federation (the capability the reference buys with a whole GPU per
+    client, fed_worker.py:18-20); ``stage`` runs the client loss through
+    the GPipe pipeline (parallel/pp.py, gpt2 entrypoint, LM-only);
+    ``expert`` shards stacked MoE expert weights (ops/moe.py, requires
+    --moe_experts). The inner axes are mutually exclusive (make_mesh).
+    ``clients=all`` (or ``auto``) uses every visible device. The mesh is
+    built over the first N*M of ``jax.devices()``.
     """
     if not spec:
         return None
@@ -161,18 +163,19 @@ def parse_mesh(spec: str):
         if not sep:
             raise ValueError(f"--mesh: expected key=value, got {part!r}")
         kv[key.strip()] = val.strip()
-    unknown = set(kv) - {"clients", "seq", "model", "stage"}
+    unknown = set(kv) - {"clients", "seq", "model", "stage", "expert"}
     if unknown:
         raise ValueError(f"--mesh: unknown axes {sorted(unknown)} "
                          f"(supported: clients=N[,seq=M | ,model=M | "
-                         f",stage=S])")
+                         f",stage=S | ,expert=E])")
     inner = {}
-    for name in ("seq", "model", "stage"):
+    for name in ("seq", "model", "stage", "expert"):
         inner[name] = int(kv.get(name, 1))
         if inner[name] <= 0:
             raise ValueError(f"--mesh: {name} must be positive, "
                              f"got {inner[name]}")
-    inner_total = inner["seq"] * inner["model"] * inner["stage"]
+    inner_total = (inner["seq"] * inner["model"] * inner["stage"]
+                   * inner["expert"])
     clients = kv.get("clients", "all")
     if clients in ("all", "auto"):
         return make_mesh(None, **inner)

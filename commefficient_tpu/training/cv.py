@@ -114,6 +114,10 @@ def train(args, mesh=None, max_rounds=None, log=True):
         raise ValueError("--mesh stage=S (GPipe pipeline) is wired for "
                          "the gpt2 entrypoint; CV models have no stacked "
                          "block trunk")
+    if mesh is not None and mesh.shape.get("expert", 1) > 1:
+        raise ValueError("--mesh expert=E (MoE expert parallelism) is "
+                         "wired for the gpt2 entrypoint; CV models have "
+                         "no MoE blocks")
     train_set = make_dataset(args, train=True)
     val_set = make_dataset(args, train=False)
     args.num_clients = train_set.num_clients

@@ -22,7 +22,7 @@ def test_parse_mesh_grammar():
     m = parse_mesh("clients=all")
     assert m.shape["clients"] == len(jax.devices())
     with pytest.raises(ValueError, match="unknown axes"):
-        parse_mesh("clients=4,expert=2")
+        parse_mesh("clients=4,shard=2")
     with pytest.raises(ValueError, match="key=value"):
         parse_mesh("clients")
 
@@ -331,3 +331,75 @@ def test_cv_cli_rejects_stage_axis(tmp_path):
     with pytest.raises(ValueError, match="no stacked block trunk"):
         main(["--test", "--mesh", "clients=2,stage=2",
               "--dataset_name", "Synthetic", "--dataset_dir", str(tmp_path)])
+
+
+def test_parse_mesh_expert_axis_grammar():
+    m = parse_mesh("clients=2,expert=4")
+    assert dict(m.shape) == {"clients": 2, "expert": 4}
+    with pytest.raises(ValueError, match="ONE inner axis"):
+        parse_mesh("clients=2,expert=2,stage=2")
+
+
+def test_gpt2_ep_federated_round_matches_unsharded(tmp_path):
+    # the last parallelism axis composed with the federated round: MoE
+    # expert weights shard over an 'expert' mesh axis inside the fused
+    # client loss (param_specs -> moe_ep_specs re-constrain), trajectory
+    # identical to the unsharded MoE run. Capacity factor high so expert
+    # capacity is non-binding (group-dependent drops would differ only
+    # under binding capacity, ops/moe.py docstring); gpt2-tiny dropout=0.
+    from commefficient_tpu.training.gpt2 import build_gpt2_parser, train
+
+    def run(mesh_spec):
+        args = build_gpt2_parser().parse_args(
+            ["--mode", "uncompressed", "--error_type", "none",
+             "--virtual_momentum", "0.9", "--num_workers", "4",
+             "--local_batch_size", "2", "--max_seq_len", "32",
+             "--moe_experts", "4", "--moe_capacity_factor", "100",
+             "--dataset_name", "SyntheticPersona",
+             "--dataset_dir", str(tmp_path / "d"),
+             "--synthetic_personas", "8", "--synthetic_dialogs", "2",
+             "--weight_decay", "0", "--num_epochs", "1"]
+            + (["--mesh", mesh_spec] if mesh_spec else []))
+        mesh = parse_mesh(args.mesh)
+        round_up_workers_for_mesh(args, mesh)
+        np.random.seed(args.seed)
+        learner, row = train(args, mesh=mesh, max_rounds=2, log=False)
+        return np.asarray(learner.state.weights), row
+
+    w_ep, row_ep = run("clients=2,expert=4")
+    w_ref, row_ref = run("")
+    np.testing.assert_allclose(w_ep, w_ref, atol=2e-4)
+    assert row_ep["nll"] == pytest.approx(row_ref["nll"], abs=1e-3)
+
+
+def test_gpt2_expert_mesh_requires_moe(tmp_path):
+    from commefficient_tpu.training.gpt2 import build_gpt2_parser, train
+    args = build_gpt2_parser().parse_args(
+        ["--mode", "uncompressed", "--error_type", "none",
+         "--max_seq_len", "32", "--dataset_name", "SyntheticPersona",
+         "--dataset_dir", str(tmp_path / "d2")])
+    mesh = parse_mesh("clients=2,expert=4")
+    with pytest.raises(ValueError, match="moe_experts"):
+        train(args, mesh=mesh, log=False)
+
+
+def test_cv_cli_rejects_expert_axis(tmp_path):
+    from commefficient_tpu.training.cv import main
+    with pytest.raises(ValueError, match="no MoE blocks"):
+        main(["--test", "--mesh", "clients=2,expert=4",
+              "--dataset_name", "Synthetic", "--dataset_dir", str(tmp_path)])
+
+
+def test_gpt2_moe_rejects_seq_and_stage_meshes(tmp_path):
+    # the seq/stage losses don't collect the MoE aux loss — must be loud
+    from commefficient_tpu.training.gpt2 import build_gpt2_parser, train
+    for mesh_spec, extra in (("clients=4,seq=2", ["--attn_impl", "ring"]),
+                             ("clients=2,stage=2", ["--mc_coef", "0"])):
+        args = build_gpt2_parser().parse_args(
+            ["--mode", "uncompressed", "--error_type", "none",
+             "--moe_experts", "4", "--max_seq_len", "32",
+             "--dataset_name", "SyntheticPersona",
+             "--dataset_dir", str(tmp_path / "d")] + extra)
+        mesh = parse_mesh(mesh_spec)
+        with pytest.raises(ValueError, match="aux loss"):
+            train(args, mesh=mesh, log=False)
