@@ -11,6 +11,7 @@
 #   --mesh clients=4,seq=2            + sequence-parallel ring attention
 #   --mesh clients=2,model=4          + Megatron-TP sharded params
 #   --mesh clients=2,stage=4 --mc_coef 0   + GPipe pipeline (LM-only)
+#   --mesh clients=2,expert=4 --moe_experts 4   + expert-sharded MoE
 #
 # Single-chip at capacity: --mode local_topk --error_type local
 #   --local_momentum 0.9 --client_state_offload parks the 2 x clients x
