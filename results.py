@@ -531,7 +531,9 @@ def write_regime_markdown(rows: list,
         "so cells differ ONLY in participation (workers of 100 clients) "
         "and fedavg local epochs — the axes the FetchSGD paper says break "
         "FedAvg. Each cell: 2-LR probe at seed 21, better LR re-run on "
-        "seeds 42/77. Note the modes see different amounts of data per "
+        "the remaining seeds (5 per cell; the 2% cells were extended "
+        "first when 3 seeds proved too few to order them). Note the "
+        "modes see different amounts of data per "
         "round by definition (fedavg consumes whole clients per round; "
         "sketch consumes one 16-image minibatch per sampled client): the "
         "budget held fixed is COMMUNICATION, the federated constraint.",
