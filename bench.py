@@ -9,7 +9,11 @@
 
 Prints ONE JSON line: the primary metric fields plus ``extra_metrics`` and
 a per-component ``breakdown_ms`` of the sketch round (where the time goes:
-sketching the aggregate, unsketching, per-client grads).
+sketching the aggregate, unsketching, per-client grads) and of the
+host-offload pipeline (gather/scatter overlap). Each metric runs ISOLATED
+with bounded retry on transient tunnel/remote-compile errors: a flaky
+metric reports None and an ``errors`` entry instead of zeroing the whole
+artifact, and the process exits 0 as long as the JSON was produced.
 
 ``--profile DIR`` wraps the timed rounds in ``jax.profiler.trace`` for
 TensorBoard inspection. The reference publishes no numbers (BASELINE.md),
@@ -356,80 +360,218 @@ def bench_longcontext_tokens():
     return B * T / float(np.median(times))
 
 
+def bench_offload_overlap(n_rounds=8):
+    """Host-offloaded client rows: the SYNC round pays gather + compute +
+    scatter serially on the critical path, while the async pipeline
+    (api.HostOffloadPipeline) gathers round t+1's rows and lazily writes
+    back round t-1's outputs while round t computes. ResNet9 local_topk
+    with local momentum + local error — the same two-field client state
+    the offloaded persona_small runs carry. Returns breakdown timings
+    including how much of the gather+scatter host time the pipeline hid
+    (round-5 VERDICT: offload rounds ran ~4.5 s with neither stacked
+    transfers nor prefetch; this measures the recovery)."""
+    import jax
+    import jax.numpy as jnp
+
+    from commefficient_tpu.config import FedConfig
+    from commefficient_tpu.federated.api import FedLearner
+    from commefficient_tpu.federated.losses import make_cv_loss
+    from commefficient_tpu.models import ResNet9
+
+    W, B, N = 4, 16, 12
+    model = ResNet9(num_classes=10, dtype="bfloat16")
+    rng = np.random.RandomState(0)
+    images = jnp.asarray(rng.randn(W, B, 32, 32, 3).astype(np.float32))
+    targets = jnp.asarray(rng.randint(0, 10, (W, B)).astype(np.int32))
+    mask = jax.device_put(jnp.ones((W, B), jnp.float32))
+    batch = (jax.device_put(images), jax.device_put(targets))
+
+    def make_learner():
+        cfg = FedConfig(mode="local_topk", k=50_000, error_type="local",
+                        local_momentum=0.9, virtual_momentum=0,
+                        num_workers=W, num_clients=N, lr_scale=0.1,
+                        client_state_offload=True)
+        return FedLearner(model, cfg, make_cv_loss(model), None,
+                          jax.random.PRNGKey(0), np.asarray(images[0][:1]))
+
+    def ids_fn(r):
+        return (np.arange(W) + r * W) % N
+
+    # sync convention: train_round flushes the pipeline every round, so
+    # gather/compute/scatter serialize — the pre-pipeline critical path
+    ln = make_learner()
+    ln.train_round(ids_fn(0), batch, mask)  # compile
+    ln.train_round(ids_fn(1), batch, mask)  # warm
+    t0 = time.perf_counter()
+    for r in range(n_rounds):
+        ln.train_round(ids_fn(2 + r), batch, mask)
+    sync_t = (time.perf_counter() - t0) / n_rounds
+
+    # async convention: gather-ahead + lazy writeback, one metric sync and
+    # one flush per window (the training-loop steady state)
+    ln = make_learner()
+    ln.train_round(ids_fn(0), batch, mask)  # compile
+    ln.train_round(ids_fn(1), batch, mask)  # warm
+    stats = ln._offload_pipe.stats
+    stats["gather_s"] = stats["scatter_s"] = 0.0
+    t0 = time.perf_counter()
+    raw = None
+    for r in range(n_rounds):
+        nxt = ids_fn(3 + r) if r + 1 < n_rounds else None
+        raw = ln.train_round_async(ids_fn(2 + r), batch, mask,
+                                   next_client_ids=nxt)
+    ln.finalize_round_metrics(raw)
+    ln.flush_offload()
+    async_t = (time.perf_counter() - t0) / n_rounds
+
+    return {
+        "offload_round_sync_ms": round(sync_t * 1e3, 1),
+        "offload_round_async_ms": round(async_t * 1e3, 1),
+        # host time spent inside gather/scatter during the async window
+        "offload_gather_ms": round(stats["gather_s"] / n_rounds * 1e3, 1),
+        "offload_scatter_ms": round(stats["scatter_s"] / n_rounds * 1e3, 1),
+        # fixed cost the pipeline actually took off the critical path
+        "offload_gather_scatter_overlap_ms": round(
+            max(sync_t - async_t, 0.0) * 1e3, 1),
+    }
+
+
+#: lowercase substrings that mark an exception as a transient
+#: tunnel/remote-compile hiccup (the shared-chip failure modes that
+#: repeatedly zeroed whole bench artifacts — VERDICT r5 top item); shape
+#: errors, OOMs and genuine bugs never match, so they fail fast.
+_TRANSIENT_MARKERS = (
+    "remote_compile", "remote compile", "read body", "unavailable",
+    "deadline", "timed out", "timeout", "connection reset",
+    "connection refused", "connection aborted", "broken pipe", "tunnel",
+    "socket", "temporarily", "try again", "rpc",
+)
+
+
+def _is_transient(exc) -> bool:
+    msg = f"{type(exc).__name__}: {exc}".lower()
+    return any(m in msg for m in _TRANSIENT_MARKERS)
+
+
+def _run_metric(name, fn, errors, retries=2):
+    """Run one bench in isolation: a failure in metric A must not cost
+    metrics B..Z their numbers. Transient tunnel/remote-compile errors
+    get up to ``retries`` fresh re-runs (each attempt rebuilds the
+    learner from scratch — ``fn`` is a zero-arg closure) with linear
+    backoff; the terminal failure is recorded in ``errors`` and the
+    metric reports None instead of killing the process."""
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            return fn()
+        except Exception as exc:  # noqa: BLE001 — isolation is the point
+            transient = _is_transient(exc)
+            if transient and attempt <= retries:
+                time.sleep(2.0 * attempt)
+                continue
+            errors.append({"metric": name,
+                           "error": f"{type(exc).__name__}: {exc}"[:500],
+                           "transient": transient,
+                           "attempts": attempt})
+            return None
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--profile", default=None,
                     help="directory for a jax.profiler trace of the bench")
+    ap.add_argument("--retries", type=int, default=2,
+                    help="re-runs per metric on transient tunnel errors")
     args = ap.parse_args()
 
     from commefficient_tpu.utils.logging import profile_ctx
 
-    with profile_ctx(args.profile):
-        rounds_per_sec, breakdown = bench_cifar_sketch()
-        cifar_exact, _ = bench_cifar_sketch(approx_recall=0.0)
-        gpt2_tokens, gpt2_tokens_pd = bench_gpt2_tokens()
-        gpt2_tokens_flash, _ = bench_gpt2_tokens(attn_impl="blockwise")
-        gpt2_sketch, gpt2_sketch_pd = bench_gpt2_sketch_rounds()
-        gpt2_sketch_exact, _ = bench_gpt2_sketch_rounds(approx_recall=0.0,
-                                                        per_dispatch=False)
-        longctx_tokens = bench_longcontext_tokens()
+    errors = []
 
+    def run(name, fn):
+        return _run_metric(name, fn, errors, retries=args.retries)
+
+    with profile_ctx(args.profile):
+        cifar = run("cifar10_resnet9_fed_rounds_per_sec", bench_cifar_sketch)
+        cifar_exact = run("cifar10_resnet9_fed_rounds_per_sec_exact_topk",
+                          lambda: bench_cifar_sketch(approx_recall=0.0))
+        gpt2 = run("gpt2_personachat_tokens_per_sec_chip", bench_gpt2_tokens)
+        gpt2_flash = run(
+            "gpt2_personachat_tokens_per_sec_chip_flash_attn",
+            lambda: bench_gpt2_tokens(attn_impl="blockwise"))
+        sketch = run("gpt2_fetchsgd_sketch_rounds_per_sec",
+                     bench_gpt2_sketch_rounds)
+        sketch_exact = run(
+            "gpt2_fetchsgd_sketch_rounds_per_sec_exact_topk",
+            lambda: bench_gpt2_sketch_rounds(approx_recall=0.0,
+                                             per_dispatch=False))
+        longctx = run("gpt2_longcontext_4k_blockwise_tokens_per_sec_chip",
+                      bench_longcontext_tokens)
+        offload = run("offload_gather_scatter_overlap",
+                      bench_offload_overlap)
+
+    rounds_per_sec, breakdown = cifar if cifar is not None else (None, {})
+    config = {"topk_approx_recall": breakdown.pop("topk_approx_recall")} \
+        if "topk_approx_recall" in breakdown else {}
+    if offload is not None:
+        breakdown.update(offload)
+
+    extras = []
+
+    def add(metric, value, unit, config=None):
+        if value is None:
+            return
+        entry = {"metric": metric, "value": value, "unit": unit}
+        if config:
+            entry["config"] = config
+        extras.append(entry)
+
+    add("cifar10_resnet9_fed_rounds_per_sec_exact_topk",
+        round(cifar_exact[0], 4) if cifar_exact is not None else None,
+        "rounds/sec", {"topk_approx_recall": 0.0})
+    add("gpt2_personachat_tokens_per_sec_chip",
+        round(gpt2[0], 1) if gpt2 is not None else None, "tokens/sec",
+        {"note": "train_rounds_scan windows (K=12 rounds per dispatch, "
+                 "one metric sync per window); reference-parity dropout "
+                 "semantics (attn_pdrop on probabilities)"})
+    add("gpt2_personachat_tokens_per_sec_chip_per_round_dispatch",
+        round(gpt2[1], 1) if gpt2 is not None else None, "tokens/sec",
+        {"note": "one host dispatch per round (rounds 1-3 measurement "
+                 "mode)"})
+    add("gpt2_personachat_tokens_per_sec_chip_flash_attn",
+        round(gpt2_flash[0], 1) if gpt2_flash is not None else None,
+        "tokens/sec",
+        {"attn_impl": "blockwise",
+         "note": "output-dropout instead of (T,T) prob masks — "
+                 "ROOFLINE.md dropout-tax A/B"})
+    add("gpt2_fetchsgd_sketch_rounds_per_sec",
+        round(sketch[0], 4) if sketch is not None else None, "rounds/sec",
+        {"topk_approx_recall": 0.95,
+         "note": "train_rounds_scan windows (K=6)"})
+    add("gpt2_fetchsgd_sketch_rounds_per_sec_per_round_dispatch",
+        round(sketch[1], 4) if sketch is not None else None, "rounds/sec",
+        {"topk_approx_recall": 0.95,
+         "note": "one host dispatch per round (rounds 1-3 measurement "
+                 "mode)"})
+    add("gpt2_fetchsgd_sketch_rounds_per_sec_exact_topk",
+        round(sketch_exact[0], 4) if sketch_exact is not None else None,
+        "rounds/sec", {"topk_approx_recall": 0.0})
+    add("gpt2_longcontext_4k_blockwise_tokens_per_sec_chip",
+        round(longctx, 1) if longctx is not None else None, "tokens/sec")
+
+    # always ONE JSON line and exit 0 — partial numbers beat no artifact;
+    # consumers check "errors" for what (if anything) went missing
     print(json.dumps({
         "metric": "cifar10_resnet9_fed_rounds_per_sec",
-        "value": round(rounds_per_sec, 4),
+        "value": round(rounds_per_sec, 4) if rounds_per_sec is not None
+        else None,
         "unit": "rounds/sec",
         "vs_baseline": 1.0,
-        "config": {"topk_approx_recall": breakdown.pop("topk_approx_recall")},
-        "extra_metrics": [{
-            "metric": "cifar10_resnet9_fed_rounds_per_sec_exact_topk",
-            "value": round(cifar_exact, 4),
-            "unit": "rounds/sec",
-            "config": {"topk_approx_recall": 0.0},
-        }, {
-            "metric": "gpt2_personachat_tokens_per_sec_chip",
-            "value": round(gpt2_tokens, 1),
-            "unit": "tokens/sec",
-            "config": {"note": "train_rounds_scan windows (K=12 rounds "
-                               "per dispatch, one metric sync per window); "
-                               "reference-parity dropout semantics "
-                               "(attn_pdrop on probabilities)"},
-        }, {
-            "metric": "gpt2_personachat_tokens_per_sec_chip_per_round_dispatch",
-            "value": round(gpt2_tokens_pd, 1),
-            "unit": "tokens/sec",
-            "config": {"note": "one host dispatch per round (rounds 1-3 "
-                               "measurement mode)"},
-        }, {
-            "metric": "gpt2_personachat_tokens_per_sec_chip_flash_attn",
-            "value": round(gpt2_tokens_flash, 1),
-            "unit": "tokens/sec",
-            "config": {"attn_impl": "blockwise",
-                       "note": "output-dropout instead of (T,T) prob "
-                               "masks — ROOFLINE.md dropout-tax A/B"},
-        }, {
-            "metric": "gpt2_fetchsgd_sketch_rounds_per_sec",
-            "value": round(gpt2_sketch, 4),
-            "unit": "rounds/sec",
-            "config": {"topk_approx_recall": 0.95,
-                       "note": "train_rounds_scan windows (K=6)"},
-        }, {
-            "metric": "gpt2_fetchsgd_sketch_rounds_per_sec_per_round_dispatch",
-            "value": round(gpt2_sketch_pd, 4),
-            "unit": "rounds/sec",
-            "config": {"topk_approx_recall": 0.95,
-                       "note": "one host dispatch per round (rounds 1-3 "
-                               "measurement mode)"},
-        }, {
-            "metric": "gpt2_fetchsgd_sketch_rounds_per_sec_exact_topk",
-            "value": round(gpt2_sketch_exact, 4),
-            "unit": "rounds/sec",
-            "config": {"topk_approx_recall": 0.0},
-        }, {
-            "metric": "gpt2_longcontext_4k_blockwise_tokens_per_sec_chip",
-            "value": round(longctx_tokens, 1),
-            "unit": "tokens/sec",
-        }],
+        "config": config,
+        "extra_metrics": extras,
         "breakdown_ms": breakdown,
+        "errors": errors,
     }))
 
 

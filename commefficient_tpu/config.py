@@ -82,6 +82,12 @@ class FedConfig:
     # round. Trajectory-identical to device-resident state
     # (tests/test_offload.py); incompatible with --mesh and --scan_rounds.
     client_state_offload: bool = False
+    # Offload pipeline depth (api.HostOffloadPipeline): how many rounds of
+    # output rows may sit in the lazy-writeback queue while their (W, d)
+    # device buffers stay alive. 2 = double buffering (gather round t+1 /
+    # scatter round t-1 while round t computes); 1 = at most one round in
+    # flight. Trajectory-identical at any depth (tests/test_offload_async).
+    offload_pipeline_depth: int = 2
     local_batch_size: int = 8  # -1 => each client's whole dataset per round
     valid_batch_size: int = 8
     microbatch_size: int = -1
@@ -141,6 +147,9 @@ class FedConfig:
         if self.sketch_scheme not in ("tiled", "global"):
             raise ValueError("sketch_scheme must be 'tiled' or 'global', "
                              f"got {self.sketch_scheme!r}")
+        if self.offload_pipeline_depth < 1:
+            raise ValueError("offload_pipeline_depth must be >= 1, got "
+                             f"{self.offload_pipeline_depth}")
         # parse-time invariants, reference utils.py:225-228
         if self.mode == "fedavg":
             if self.local_batch_size != -1:

@@ -41,3 +41,22 @@ def device_prefetch(batches: Iterable, size: int = 2,
             yield buf.popleft()
     while buf:
         yield buf.popleft()
+
+
+def with_lookahead(items: Iterable) -> Iterator:
+    """Yield ``(item, next_item_or_None)`` pairs — one-item lookahead.
+
+    The offload pipeline's gather-ahead (api.HostOffloadPipeline) needs
+    the NEXT round's pre-sampled client ids while the current round
+    dispatches; wrapping the (already device-prefetched) batch iterator
+    exposes them without touching the sampler. The final item pairs with
+    ``None`` (no prefetch for a round that never runs)."""
+    it = iter(items)
+    try:
+        cur = next(it)
+    except StopIteration:
+        return
+    for nxt in it:
+        yield cur, nxt
+        cur = nxt
+    yield cur, None

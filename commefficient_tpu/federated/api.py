@@ -17,6 +17,8 @@ to coordinate — state is explicit and the round is one jitted function:
 
 from __future__ import annotations
 
+import time
+from collections import deque
 from typing import Callable, Iterable, Optional
 
 import jax
@@ -26,7 +28,8 @@ import numpy as np
 from commefficient_tpu.config import FedConfig
 from commefficient_tpu.federated.round import (
     FedState, build_eval_step, build_round_step, init_fed_state)
-from commefficient_tpu.federated.state import ClientState
+from commefficient_tpu.federated.state import (CLIENT_STATE_FIELDS,
+                                               ClientState)
 from commefficient_tpu.utils.params import flatten_params
 from commefficient_tpu.utils.schedules import PiecewiseLinear
 
@@ -69,11 +72,17 @@ class FedLearner:
         # pinned memory — bounded by host RAM like the reference's shm
         # design (fed_aggregator.py:116-129) — and only the sampled rows
         # move to device each round (round.build_round_step offload path).
+        # Row movement runs through a double-buffered async pipeline
+        # (HostOffloadPipeline): next-round gathers and last-round
+        # writebacks overlap the current round's compute.
         self._offload = (self.cfg.client_state_offload
                          and self.cfg.has_client_state)
         self.host_clients = None
+        self._offload_pipe = None
         if self._offload:
             self._init_host_rows(flat)
+            self._offload_pipe = HostOffloadPipeline(
+                self, depth=self.cfg.offload_pipeline_depth)
         if mesh is not None:
             from commefficient_tpu.parallel.mesh import (batch_shardings,
                                                          shard_state)
@@ -164,34 +173,15 @@ class FedLearner:
             return jax.device_put(x, self._s_host)
         return np.asarray(x)
 
-    def _gather_host(self, field, ids_np):
-        """Stack the sampled clients' host rows into a (W, d) device
-        array. Out-of-range ids (padded epoch-tail slots) clamp like the
-        device gather would; their rows are inert (zero mask)."""
-        lst = self.host_clients[field]
-        if lst is None:
-            return None
-        n = len(lst)
-        picked = [lst[int(np.clip(i, 0, n - 1))] for i in ids_np]
-        if self._s_host is not None:
-            picked = [jax.device_put(r, self._s_dev) for r in picked]
-        return jnp.stack(picked)
-
-    def _scatter_host(self, ids_np, valid, out_rows):
-        """Write the round's output rows back to host memory. The round
-        returns the INPUT row for aborted/invalid slots, so writes are
-        value-correct unconditionally; invalid (padded) slots are still
-        skipped so a padded id-0 slot can never clobber a real client-0
-        update in the same round."""
-        for field, new in (("velocities", out_rows.velocities),
-                           ("errors", out_rows.errors),
-                           ("weights", out_rows.weights)):
-            lst = self.host_clients[field]
-            if lst is None or new is None:
-                continue
-            for w, cid in enumerate(ids_np):
-                if valid[w] and 0 <= cid < len(lst):
-                    lst[int(cid)] = self._to_host(new[w])
+    def flush_offload(self):
+        """Drain the offload pipeline: apply every pending host writeback
+        and drop any gather-ahead buffer. No-op off the offload path.
+        ``train_round`` (the blocking wrapper) calls this so synchronous
+        callers — and everything that reads ``host_clients`` directly:
+        tests, checkpointing — always see current rows; async loops defer
+        it to epoch boundaries."""
+        if self._offload_pipe is not None:
+            self._offload_pipe.flush_all()
 
     @property
     def batch_shardings(self):
@@ -207,7 +197,8 @@ class FedLearner:
     def lr_at(self, t: float) -> float:
         return float(self.lr_schedule(t))
 
-    def train_round_async(self, client_ids, batch, mask, epoch_frac=None):
+    def train_round_async(self, client_ids, batch, mask, epoch_frac=None,
+                          next_client_ids=None):
         """Dispatch one federated round WITHOUT blocking on the result.
 
         Returns the round's raw metrics as device arrays; pass them to
@@ -217,7 +208,14 @@ class FedLearner:
         training loop that only finalizes metrics at logging points runs at
         device throughput instead of round latency (the reference pays the
         equivalent cost as blocking queue round-trips per round,
-        fed_aggregator.py:303-318)."""
+        fed_aggregator.py:303-318).
+
+        ``next_client_ids``: the NEXT round's pre-sampled client ids
+        (offload path only; ignored otherwise). When given, round t+1's
+        host rows are gathered while round t computes and round t-1's
+        output rows write back lazily (HostOffloadPipeline), so the
+        host<->device row traffic overlaps compute instead of serializing
+        the round."""
         lr = self.lr_at(self.rounds_done if epoch_frac is None else epoch_frac)
         self.rng, round_rng = jax.random.split(self.rng)
         ids = jnp.asarray(client_ids, jnp.int32)
@@ -232,13 +230,13 @@ class FedLearner:
         if self._offload:
             ids_np = np.asarray(client_ids).astype(np.int64)
             valid = np.asarray(mask).any(axis=1)
-            rows = ClientState(
-                velocities=self._gather_host("velocities", ids_np),
-                errors=self._gather_host("errors", ids_np),
-                weights=self._gather_host("weights", ids_np))
+            rows = self._offload_pipe.gather(ids_np)
             self.state, out_rows, metrics = self._round(
                 self.state, rows, ids, cols, m, lr_in, round_rng)
-            self._scatter_host(ids_np, valid, out_rows)
+            self._offload_pipe.push(ids_np, valid, out_rows)
+            if next_client_ids is not None:
+                self._offload_pipe.prefetch(
+                    np.asarray(next_client_ids).astype(np.int64))
         else:
             self.state, metrics = self._round(self.state, ids, cols, m,
                                               lr_in, round_rng)
@@ -274,10 +272,14 @@ class FedLearner:
         }
 
     def train_round(self, client_ids, batch, mask, epoch_frac=None):
-        """Run one federated round and block for its metrics."""
-        return self.finalize_round_metrics(
+        """Run one federated round and block for its metrics (offloaded
+        host rows are flushed too, so ``host_clients`` is always current
+        after a synchronous round)."""
+        out = self.finalize_round_metrics(
             self.train_round_async(client_ids, batch, mask,
                                    epoch_frac=epoch_frac))
+        self.flush_offload()
+        return out
 
     def _rounds_scan_fn(self):
         """Lazily-built jitted K-round scan (see train_rounds_scan)."""
@@ -419,6 +421,153 @@ class FedLearner:
                 "metrics": (metric_sums if metric_sums is not None
                             else np.zeros(1)) / n,
                 "num_datapoints": n}
+
+
+class HostOffloadPipeline:
+    """Double-buffered async gather/scatter of host-offloaded client rows.
+
+    The synchronous offload path serialized three stages per round:
+    host-gather the sampled (W, d) rows, run the jitted round, scatter the
+    output rows back — a device<->host transfer of up to 2 GB at GPT2
+    scale blocking every round. This pipeline takes both transfers off
+    the critical path:
+
+    * **gather-ahead**: with the next round's pre-sampled client ids
+      (``prefetch``), round t+1's input rows are stacked and put on
+      device while round t computes; the jitted round still donates the
+      (W, d) buffer, so at most ``depth`` input/output row buffers are
+      alive at once (depth 2 = classic double buffering).
+    * **lazy scatter**: a finished round's output rows sit in a bounded
+      ``pending`` queue as device arrays and write back to the host rows
+      when the queue overflows or ``flush_all`` runs (epoch boundaries,
+      ``train_round``, checkpointing).
+
+    Correctness under overlap (the read-after-write hazard when round
+    t+1 samples a client round t also touched): ``gather`` resolves each
+    requested id against the pending queue newest-first before falling
+    back to the host row, so a round always sees the latest value of
+    every client row no matter when the writeback lands — and because
+    the round returns the INPUT row for aborted/invalid slots, pending
+    entries are value-correct even across NaN-guard rounds. Padded
+    (invalid) slots are skipped on writeback exactly like the
+    synchronous path, so a padded id-0 slot can never clobber a real
+    client-0 update. Equivalence with the synchronous path — weights,
+    rows, and byte accounting, including abort and padded-tail rounds —
+    is pinned in tests/test_offload_async.py.
+
+    ``stats`` counts gathers/prefetch hits/pending-row hits and
+    accumulates host-side seconds spent building gathers vs flushing
+    writebacks (bench.py reports the overlap these buy)."""
+
+    def __init__(self, learner: "FedLearner", depth: int = 2):
+        self.learner = learner
+        self.depth = max(1, int(depth))
+        self._pending = deque()     # (ids_np, valid_np, out_rows) FIFO
+        self._prefetched = None     # (key tuple, rows ClientState)
+        self._pushes = 0            # pending-queue generation counter
+        self._prefetch_gen = -1
+        self.stats = {"gathers": 0, "prefetch_hits": 0,
+                      "rows_from_pending": 0, "flushed_rounds": 0,
+                      "gather_s": 0.0, "scatter_s": 0.0}
+
+    # --- gather side -----------------------------------------------------
+    def _resolve_row(self, field, cid, lst):
+        """Latest value of client ``cid``'s ``field`` row: the newest
+        pending (not yet written back) output row if one exists, else the
+        host row. Within a round the last valid slot wins, matching the
+        ascending-w host writeback order."""
+        for ids_np, valid, out in reversed(self._pending):
+            new = getattr(out, field)
+            if new is None:
+                continue
+            for w in range(len(ids_np) - 1, -1, -1):
+                if valid[w] and ids_np[w] == cid:
+                    self.stats["rows_from_pending"] += 1
+                    return new[w], True
+        return lst[cid], False
+
+    def _build_gather(self, ids_np):
+        """Stack the sampled clients' rows into (W, d) device arrays.
+        Out-of-range ids (padded epoch-tail slots) clamp like the device
+        gather would; their rows are inert (zero mask)."""
+        ln = self.learner
+        t0 = time.perf_counter()
+        fields = {}
+        for field in CLIENT_STATE_FIELDS:
+            lst = ln.host_clients[field]
+            if lst is None:
+                fields[field] = None
+                continue
+            n = len(lst)
+            picked, any_pending = [], False
+            for i in ids_np:
+                row, from_pending = self._resolve_row(
+                    field, int(np.clip(i, 0, n - 1)), lst)
+                any_pending = any_pending or from_pending
+                picked.append(row)
+            if ln._s_host is None and not any_pending:
+                # numpy host rows, nothing in flight: ONE stacked
+                # host->device transfer instead of W row puts
+                fields[field] = jnp.asarray(np.stack(picked))
+            else:
+                # device_put is a no-op for rows already on device
+                # (pending-queue slices); pinned-host rows transfer
+                picked = [jax.device_put(r, ln._s_dev) for r in picked]
+                fields[field] = jnp.stack(picked)
+        self.stats["gathers"] += 1
+        self.stats["gather_s"] += time.perf_counter() - t0
+        return ClientState(**fields)
+
+    def gather(self, ids_np):
+        """Rows for a round about to dispatch: the gather-ahead buffer if
+        it matches (same ids, no round pushed since it was built), else a
+        fresh stack."""
+        if self._prefetched is not None:
+            key, rows = self._prefetched
+            self._prefetched = None
+            if (key == tuple(int(i) for i in ids_np)
+                    and self._prefetch_gen == self._pushes):
+                self.stats["prefetch_hits"] += 1
+                return rows
+        return self._build_gather(ids_np)
+
+    def prefetch(self, ids_np):
+        """Start the NEXT round's gather now (its host->device transfers
+        overlap the current round's device compute)."""
+        self._prefetched = (tuple(int(i) for i in ids_np),
+                            self._build_gather(ids_np))
+        self._prefetch_gen = self._pushes
+
+    # --- scatter side ----------------------------------------------------
+    def push(self, ids_np, valid, out_rows):
+        """Queue a finished round's output rows for lazy writeback."""
+        self._pending.append((np.asarray(ids_np), np.asarray(valid),
+                              out_rows))
+        self._pushes += 1
+        while len(self._pending) > self.depth:
+            self._flush_one()
+
+    def _flush_one(self):
+        ln = self.learner
+        t0 = time.perf_counter()
+        ids_np, valid, out = self._pending.popleft()
+        for field in CLIENT_STATE_FIELDS:
+            lst = ln.host_clients[field]
+            new = getattr(out, field)
+            if lst is None or new is None:
+                continue
+            for w, cid in enumerate(ids_np):
+                if valid[w] and 0 <= cid < len(lst):
+                    lst[int(cid)] = ln._to_host(new[w])
+        self.stats["flushed_rounds"] += 1
+        self.stats["scatter_s"] += time.perf_counter() - t0
+
+    def flush_all(self):
+        """Apply every pending writeback and drop the gather-ahead buffer
+        (host rows may be replaced right after, e.g. checkpoint load)."""
+        while self._pending:
+            self._flush_one()
+        self._prefetched = None
 
 
 class RoundPipeline:

@@ -27,6 +27,13 @@ class ServerOptState:
     Verror: jax.Array
 
 
+#: ClientState field names in canonical (writeback) order — the single
+#: list the offload pipeline, host-row allocation, and checkpointing
+#: iterate over, so a new per-client field can't be silently skipped by
+#: one of them.
+CLIENT_STATE_FIELDS = ("velocities", "errors", "weights")
+
+
 @struct.dataclass
 class ClientState:
     """Per-client persistent state, rows indexed by client id.
@@ -34,8 +41,10 @@ class ClientState:
     The reference allocates these as host shared-memory tensors of shape
     ``(num_clients, grad_size)`` or ``(num_clients, r, c)``
     (fed_aggregator.py:116-129). Here they are device arrays sharded along
-    the leading ``clients`` axis of the mesh. Fields are ``None`` when the
-    run's mode doesn't need them.
+    the leading ``clients`` axis of the mesh — or, under
+    ``client_state_offload``, per-client host rows streamed through
+    ``api.HostOffloadPipeline``. Fields are ``None`` when the run's mode
+    doesn't need them.
     """
     velocities: Optional[jax.Array] = None  # local momentum state
     errors: Optional[jax.Array] = None      # local error-feedback state

@@ -90,6 +90,12 @@ def build_parser(default_lr: float = 0.4) -> argparse.ArgumentParser:
                         "natively); only the sampled rows move to device "
                         "each round. Trajectory-identical; needed for "
                         "local_topk at gpt2-small scale on one chip")
+    p.add_argument("--offload_pipeline_depth", type=int, default=2,
+                   help="rounds of offloaded output rows that may queue "
+                        "for lazy host writeback (api.HostOffloadPipeline)"
+                        ": 2 = double-buffered gather-ahead/scatter-behind"
+                        " around the computing round, 1 = one round in "
+                        "flight. Trajectory-identical at any depth")
     p.add_argument("--mesh", type=str, default="",
                    help="mesh shape as 'clients=N[,seq=M]' or 'clients=all';"
                         " empty = single-device (no mesh). See parse_mesh")

@@ -187,11 +187,16 @@ def train(args, mesh=None, max_rounds=None, log=True):
             def abort(bad):
                 print(f"NaN/divergent loss ({bad['loss']}); aborting "
                       f"(threshold {args.nan_threshold})")
+                learner.flush_offload()  # settle host rows before handing
                 return learner, {"aborted": True, "loss": bad["loss"]}
 
             # next round's batch transfers while this one computes
-            # (sharding-aware on a mesh: lands directly on the shards)
-            from commefficient_tpu.data.prefetch import device_prefetch
+            # (sharding-aware on a mesh: lands directly on the shards);
+            # the one-item lookahead feeds the offload pipeline's
+            # gather-ahead (next round's client rows transfer during this
+            # round's compute — no-op off the offload path)
+            from commefficient_tpu.data.prefetch import (device_prefetch,
+                                                         with_lookahead)
             batch_sh = learner.batch_shardings
             # --scan_rounds K>1: K rounds per host dispatch as one traced
             # lax.scan (api.ScanWindow / train_rounds_scan) — identical
@@ -202,15 +207,16 @@ def train(args, mesh=None, max_rounds=None, log=True):
             window = learner.scan_window(scan_k) if scan_k > 1 else None
 
             def check_all(outs):
-                # record EVERY finalized round's metrics before reporting
-                # the first aborted one (gpt2.py's convention; ADVICE r4)
+                # record EVERY finalized round's metrics, but report the
+                # FIRST aborted one — post-breach rounds are frozen
+                # no-ops that can print a healthy-looking loss
                 bad = None
                 for out in outs or []:
-                    bad = check(out) or bad
+                    bad = bad or check(out)
                 return bad
 
-            for ids, cols, mask in device_prefetch(batcher.epoch(),
-                                                   shardings=batch_sh):
+            for (ids, cols, mask), nxt in with_lookahead(
+                    device_prefetch(batcher.epoch(), shardings=batch_sh)):
                 frac = total_rounds / max(spe, 1)
                 if window is not None:
                     total_rounds += 1
@@ -218,8 +224,9 @@ def train(args, mesh=None, max_rounds=None, log=True):
                     if bad := check_all(window.push(ids, cols, mask, frac)):
                         return abort(bad)
                 else:
-                    raw = learner.train_round_async(ids, cols, mask,
-                                                    epoch_frac=frac)
+                    raw = learner.train_round_async(
+                        ids, cols, mask, epoch_frac=frac,
+                        next_client_ids=nxt[0] if nxt is not None else None)
                     total_rounds += 1
                     rounds_in_epoch += 1
                     if bad := check(pipe.push(raw)):
@@ -227,6 +234,9 @@ def train(args, mesh=None, max_rounds=None, log=True):
                 if (args.do_test or rounds_in_epoch >= rounds_cap
                         or (max_rounds and total_rounds >= max_rounds)):
                     break
+            # epoch boundary: settle offloaded host rows (pending lazy
+            # writebacks + any gather-ahead for a round that never ran)
+            learner.flush_offload()
             if bad := (check_all(window.flush()) if window is not None
                        else check(pipe.flush())):
                 return abort(bad)

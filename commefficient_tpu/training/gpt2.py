@@ -328,8 +328,11 @@ def train(args, mesh=None, max_rounds=None, log=True):
                 return o["aborted"]
 
             # next round's batch transfers while this one computes
-            # (sharding-aware on a mesh: lands directly on the shards)
-            from commefficient_tpu.data.prefetch import device_prefetch
+            # (sharding-aware on a mesh: lands directly on the shards);
+            # the lookahead feeds the offload pipeline's gather-ahead —
+            # the path the offloaded persona_small local_topk runs take
+            from commefficient_tpu.data.prefetch import (device_prefetch,
+                                                         with_lookahead)
             # --scan_rounds K>1: K rounds per dispatch (api.ScanWindow;
             # see training/cv.py for the convention)
             scan_k = max(1, int(getattr(args, "scan_rounds", 1) or 1))
@@ -341,27 +344,33 @@ def train(args, mesh=None, max_rounds=None, log=True):
                     bad = check(o) or bad
                 return bad
 
-            for ids, cols, mask in device_prefetch(
-                    batcher.epoch(), shardings=learner.batch_shardings):
+            for (ids, cols, mask), nxt in with_lookahead(device_prefetch(
+                    batcher.epoch(), shardings=learner.batch_shardings)):
                 if window is not None:
                     out_w = window.push(ids, cols, mask, total_rounds)
                     total_rounds += 1
                     if check_all(out_w):
                         print("NaN loss; aborting")
+                        learner.flush_offload()
                         return learner, {"aborted": True}
                 else:
-                    raw = learner.train_round_async(ids, cols, mask,
-                                                    epoch_frac=total_rounds)
+                    raw = learner.train_round_async(
+                        ids, cols, mask, epoch_frac=total_rounds,
+                        next_client_ids=nxt[0] if nxt is not None else None)
                     total_rounds += 1
                     if check(pipe.push(raw)):
                         print("NaN loss; aborting")
+                        learner.flush_offload()
                         return learner, {"aborted": True}
                 if args.do_test or (max_rounds and total_rounds >= max_rounds):
                     break
+            # epoch boundary: settle offloaded host rows (pending lazy
+            # writebacks + any gather-ahead for a round that never ran)
+            learner.flush_offload()
             if (check_all(window.flush()) if window is not None
                     else check(pipe.flush())):
                 print("NaN loss; aborting")
-                return learner, {"aborted": True}
+                return learner, {"aborted": True}  # flushed above
             train_time = timer()
             val = learner.evaluate(val_batches(val_set,
                                                args.valid_batch_size))

@@ -46,7 +46,10 @@ def save_checkpoint(path: str, learner, name: str = "model",
     widx = next(i for i, x in enumerate(flat) if x is learner.state.weights)
     extra = {"meta": np.asarray(json.dumps(meta))} if meta else {}
     # host-offloaded client state (api.FedLearner.host_clients) is not in
-    # the state pytree; persist the rows under host_{field} keys
+    # the state pytree; drain any pending async writebacks
+    # (HostOffloadPipeline), then persist the rows under host_{field} keys
+    if hasattr(learner, "flush_offload"):
+        learner.flush_offload()
     host = getattr(learner, "host_clients", None)
     if host:
         for field, lst in host.items():
@@ -69,6 +72,11 @@ _BACKFILL = {".aborted": lambda cur: np.zeros((), bool)}
 
 def load_checkpoint(fn: str, learner) -> None:
     """Restore in place; the learner must be built with the same config."""
+    # settle the offload pipeline BEFORE overwriting host rows: a pending
+    # writeback or gather-ahead buffer landing after the restore would
+    # resurrect pre-load rows
+    if hasattr(learner, "flush_offload"):
+        learner.flush_offload()
     with np.load(fn) as z:
         flat, paths, treedef = _state_arrays(learner.state)
         n_saved = sum(1 for k in z.files if k.startswith("arr_"))

@@ -420,7 +420,7 @@ def write_grid_small_markdown(grid: list,
 # the LR schedule completes exactly at the budget (fractional final epochs
 # truncate, training/cv.py).
 REGIME_ROUNDS = 240
-REGIME_SEEDS = ("21", "42", "77")
+REGIME_SEEDS = ("21", "42", "77", "91", "17")
 REGIME_LRS = {"fedavg": ["0.2", "0.05"], "sketch": ["0.2", "0.08"]}
 
 
@@ -433,11 +433,14 @@ def _regime_cells():
 _REGIME_DS = {}
 
 
-def _regime_epochs(mode: str, W: int) -> float:
-    """num_epochs such that schedule-rounds == REGIME_ROUNDS. spe comes
-    from the SAME batcher the run will use (FedBatcher over the real
-    patches32 recipe) so the budget can't silently drift from the
-    recipe's batch/client constants (ADVICE: no re-hardcoded constants)."""
+def _regime_schedule(mode: str, W: int) -> tuple:
+    """(num_epochs, pivot_epoch) such that schedule-rounds ==
+    REGIME_ROUNDS and the LR peak stays at the same FRACTION of the run
+    as the headline recipe. spe comes from the SAME batcher the run will
+    use (FedBatcher over the real patches32 recipe), and the pivot ratio
+    from the recipe's own parsed --pivot_epoch/--num_epochs, so neither
+    the budget nor the schedule shape can silently drift from the
+    recipe's constants (ADVICE: no re-hardcoded constants)."""
     from commefficient_tpu.data import FedBatcher
     from commefficient_tpu.training.args import build_parser
     from commefficient_tpu.training.cv import make_dataset
@@ -450,7 +453,8 @@ def _regime_epochs(mode: str, W: int) -> float:
     spe = FedBatcher(_REGIME_DS["train"], args.num_workers,
                      args.local_batch_size,
                      seed=args.seed).steps_per_epoch()
-    return REGIME_ROUNDS / spe
+    epochs = REGIME_ROUNDS / spe
+    return epochs, epochs * args.pivot_epoch / args.num_epochs
 
 
 def run_regime(out: str = "RESULTS_regime", quick: bool = False) -> list:
@@ -481,13 +485,12 @@ def run_regime(out: str = "RESULTS_regime", quick: bool = False) -> list:
         label = f"{name}_lr{lr}_s{seed}"
         if label in done:
             return
-        epochs = _regime_epochs(mode, W)
         # keep the SCHEDULE SHAPE constant in round space: the headline
-        # recipe peaks at epoch 5 of 24 (~21% of the run); a shorter
-        # num_epochs must scale the pivot with it, or PiecewiseLinear
-        # gets non-monotonic knots (pivot 5 > num_epochs 4.8) and
-        # np.interp returns garbage (code review r5)
-        pivot = epochs * 5.0 / 24.0
+        # recipe peaks at pivot_epoch/num_epochs of the run (~21%); a
+        # shorter num_epochs must scale the pivot with it, or
+        # PiecewiseLinear gets non-monotonic knots (pivot 5 > num_epochs
+        # 4.8) and np.interp returns garbage (code review r5)
+        epochs, pivot = _regime_schedule(mode, W)
         extra = ["--lr_scale", lr, "--seed", seed,
                  "--num_workers", str(W),
                  "--num_epochs", f"{epochs:g}",
@@ -910,7 +913,8 @@ def main():
             tuned_rows_small(grid),
             lambda r: (r["task"] == "persona_small"
                        and (r["mode"] in GRID_SMALL_LRS
-                            or r["mode"].split("_s")[0] in GRID_SMALL_LRS)))
+                            or r["mode"].split("_s")[0].split("_lr")[0]
+                            in GRID_SMALL_LRS)))
         print("wrote RESULTS_grid_small.{json,md} and folded tuned rows "
               "into RESULTS.{json,md}")
         return

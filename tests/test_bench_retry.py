@@ -1,0 +1,136 @@
+"""bench.py flake-proofing: per-metric isolation + bounded retry.
+
+The bench artifact repeatedly came back empty because ONE transient
+tunnel/remote-compile hiccup killed the whole process (round-5 VERDICT
+top item). These tests pin the isolation contract host-side — no
+accelerator needed: a transient error is retried with a fresh run, a
+deterministic error fails fast, and a failed metric reports None plus an
+``errors`` entry instead of taking the other metrics down.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+def _no_sleep(monkeypatch):
+    slept = []
+    monkeypatch.setattr(bench.time, "sleep", slept.append)
+    return slept
+
+
+def test_transient_error_is_retried_with_fresh_run(monkeypatch):
+    slept = _no_sleep(monkeypatch)
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise RuntimeError("UNAVAILABLE: failed to read body through "
+                               "the chip tunnel")
+        return 7.5
+
+    errors = []
+    assert bench._run_metric("m", flaky, errors, retries=2) == 7.5
+    assert len(calls) == 3 and errors == []
+    assert len(slept) == 2  # backoff between attempts, none after success
+
+
+def test_deterministic_error_fails_fast_and_is_recorded(monkeypatch):
+    _no_sleep(monkeypatch)
+    calls = []
+
+    def broken():
+        calls.append(1)
+        raise ValueError("shapes (4, 46) and (8,) are incompatible")
+
+    errors = []
+    assert bench._run_metric("m", broken, errors, retries=2) is None
+    assert len(calls) == 1  # a shape bug must not burn retry time
+    assert errors[0]["metric"] == "m"
+    assert errors[0]["transient"] is False
+    assert errors[0]["attempts"] == 1
+    assert "incompatible" in errors[0]["error"]
+
+
+def test_transient_error_exhausts_bounded_retries(monkeypatch):
+    _no_sleep(monkeypatch)
+    calls = []
+
+    def always_flaky():
+        calls.append(1)
+        raise OSError("connection reset by peer")
+
+    errors = []
+    assert bench._run_metric("m", always_flaky, errors, retries=2) is None
+    assert len(calls) == 3  # initial run + 2 bounded retries, then stop
+    assert errors[0]["transient"] is True
+    assert errors[0]["attempts"] == 3
+
+
+def test_isolation_one_bad_metric_does_not_poison_the_next(monkeypatch):
+    _no_sleep(monkeypatch)
+    errors = []
+    a = bench._run_metric("a", lambda: 1.0, errors, retries=0)
+    b = bench._run_metric(
+        "b", lambda: (_ for _ in ()).throw(RuntimeError("DEADLINE_EXCEEDED")),
+        errors, retries=0)
+    c = bench._run_metric("c", lambda: 3.0, errors, retries=0)
+    assert (a, b, c) == (1.0, None, 3.0)
+    assert [e["metric"] for e in errors] == ["b"]
+
+
+def test_transient_classifier():
+    assert bench._is_transient(RuntimeError("remote_compile worker "
+                                            "unavailable"))
+    assert bench._is_transient(TimeoutError("deadline exceeded"))
+    assert not bench._is_transient(ValueError("bad shape"))
+    assert not bench._is_transient(MemoryError("RESOURCE limits"))
+
+
+def test_main_emits_json_and_exits_zero_despite_failed_metrics(
+        monkeypatch, capsys):
+    """The acceptance contract: bench.py produces its ONE JSON line and
+    exits 0 even when metrics die, with the survivors' numbers intact,
+    the casualties listed under ``errors``, and the offload
+    gather/scatter overlap merged into breakdown_ms."""
+    import contextlib
+    import json
+
+    _no_sleep(monkeypatch)
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    monkeypatch.setattr(
+        "commefficient_tpu.utils.logging.profile_ctx",
+        lambda _: contextlib.nullcontext())
+    monkeypatch.setattr(bench, "bench_cifar_sketch",
+                        lambda approx_recall=0.95:
+                        (2.5, {"topk_approx_recall": approx_recall,
+                               "round_throughput_ms": 400.0}))
+    monkeypatch.setattr(bench, "bench_gpt2_tokens",
+                        lambda attn_impl="full": (1000.0, 900.0))
+
+    def dead(*a, **k):
+        raise RuntimeError("UNAVAILABLE: tunnel read body")
+
+    monkeypatch.setattr(bench, "bench_gpt2_sketch_rounds", dead)
+    monkeypatch.setattr(bench, "bench_longcontext_tokens", dead)
+    monkeypatch.setattr(bench, "bench_offload_overlap",
+                        lambda: {"offload_round_sync_ms": 50.0,
+                                 "offload_round_async_ms": 30.0,
+                                 "offload_gather_ms": 10.0,
+                                 "offload_scatter_ms": 8.0,
+                                 "offload_gather_scatter_overlap_ms": 20.0})
+    bench.main()                       # must not raise (exit 0)
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["value"] == 2.5
+    assert out["breakdown_ms"]["offload_gather_scatter_overlap_ms"] == 20.0
+    metrics = {e["metric"] for e in out["extra_metrics"]}
+    assert "gpt2_personachat_tokens_per_sec_chip" in metrics
+    # the dead metrics are absent from the numbers but present in errors
+    assert "gpt2_fetchsgd_sketch_rounds_per_sec" not in metrics
+    failed = {e["metric"] for e in out["errors"]}
+    assert "gpt2_fetchsgd_sketch_rounds_per_sec" in failed
+    assert all(e["transient"] for e in out["errors"])

@@ -5,7 +5,8 @@ accelerator memory, by parking it in shared-memory tensors (reference
 fed_aggregator.py:116-129, .share_memory_() at :125-128). The TPU-native
 analog keeps those rows in pinned_host memory and moves only the sampled
 rows to device each round (federated/round.py offload path +
-api.FedLearner._gather_host/_scatter_host). These tests pin the contract:
+api.HostOffloadPipeline; tests/test_offload_async.py pins the async
+pipeline against this sync path). These tests pin the contract:
 bit-identical trajectories to device-resident state, inert padded slots,
 NaN-guard safety, and checkpoint roundtrip.
 """
