@@ -144,14 +144,17 @@ def bench_cifar_sketch(approx_recall=0.95):
 
 
 def _gpt2_fed_setup(B=8, attn_impl="full", dropout_impl="xla_rbg",
-                    fused_lm_head=False, **cfg_kw):
+                    fused_lm_head=False, T=256, attn_dropout="auto",
+                    **cfg_kw):
     """Shared gpt2-small federated-bench setup: model, learner, and a
     device-resident synthetic PersonaChat batch (W=4, B dialogs, C=2,
-    T=256 — 16k tokens/round at the default B=8, a realistic device
-    batch; round 2 ran 8k). ``attn_impl='blockwise'`` swaps in the flash
-    kernel, whose output-dropout avoids the (T,T) probability masks —
-    the measured bulk of the dropout tax (docs/ROOFLINE.md) — at a
-    documented semantic divergence from HF's attn_pdrop."""
+    T tokens — 16k tokens/round at the default B=8/T=256, a realistic
+    device batch; round 2 ran 8k). ``attn_impl='blockwise'`` swaps in
+    the flash kernel; ``attn_dropout='kernel'`` additionally REQUIRES
+    reference-parity dropout on the attention probabilities inside that
+    kernel (ops/flash_attention.py — keep-bits in-register, no (T,T)
+    masks in HBM) and raises if the kernel is ineligible, so an A/B row
+    can never silently fall back to output dropout."""
     import jax
     import jax.numpy as jnp
 
@@ -160,13 +163,14 @@ def _gpt2_fed_setup(B=8, attn_impl="full", dropout_impl="xla_rbg",
     from commefficient_tpu.federated.losses import make_gpt2_train_loss
     from commefficient_tpu.models.gpt2 import GPT2Config, GPT2DoubleHeads
 
-    W, C, T = 4, 2, 256
+    W, C = 4, 2
     gcfg = GPT2Config.small(vocab_size=50262)
     gcfg.n_positions = max(gcfg.n_positions, T)
     gcfg.dropout = 0.1
     gcfg.dtype = "bfloat16"  # MXU-native compute; params stay f32
     gcfg.attn_impl = attn_impl
-    gcfg.attn_block_size = 256
+    gcfg.attn_block_size = min(256, T)
+    gcfg.attn_dropout = attn_dropout
     # 'xla_rbg' dropout: reference-parity Bernoulli masks (attn_pdrop on
     # the probabilities) with bits drawn by the TPU hardware RngBitGenerator
     # instead of threefry — ~2x cheaper generation, same fusion behavior
@@ -259,20 +263,100 @@ def _timed_scan_windows(learner, ids_fn, batch, mask, n_windows=3,
     return float(np.median(window_times))
 
 
-def bench_gpt2_tokens(attn_impl="full"):
+def bench_gpt2_tokens(attn_impl="full", B=8, T=256, attn_dropout="auto",
+                      per_dispatch=True):
     """Returns (scan-mode tokens/s, per-round-dispatch tokens/s). The
     scan number is the headline: the device-side round is ~156 ms but
     per-round host dispatch through the chip tunnel adds ~25-30 ms/round
     that no amount of on-chip work removes (round-4 profile) —
     train_rounds_scan is the framework's answer, and the per-dispatch
-    figure is kept for comparability with rounds 1-3."""
+    figure is kept for comparability with rounds 1-3.
+    ``per_dispatch=False`` skips the second compile + timed windows (the
+    long-context row only needs the headline convention)."""
     learner, one_round, tokens_per_round, (batch, mask, ids_fn) = \
-        _gpt2_fed_setup(attn_impl=attn_impl, mode="uncompressed",
+        _gpt2_fed_setup(attn_impl=attn_impl, B=B, T=T,
+                        attn_dropout=attn_dropout, mode="uncompressed",
                         error_type="none")
-    per_dispatch = tokens_per_round / _timed_windows(learner, one_round)
+    pd = (tokens_per_round / _timed_windows(learner, one_round)
+          if per_dispatch else None)
     scanned = tokens_per_round / _timed_scan_windows(
         learner, ids_fn, batch, mask)
-    return scanned, per_dispatch
+    return scanned, pd
+
+
+def bench_flash_dropout_kernel_ab(T=256, rate=0.1):
+    """Kernel-level A/B at the federated bench's attention shape: fused
+    flash attention WITH in-kernel parity dropout (block-size sweep — the
+    kernel's DEFAULT_BLOCK_Q=2048 was tuned at T=4096 and clamps to one
+    (T, T) tile here, so the sweep covers the short-T candidates) vs the
+    incumbent XLA path (materialized scores + additive causal bias + f32
+    softmax + rbg prob dropout — exactly models/gpt2.py's 'full' branch).
+    Both time fwd+bwd through jax.grad with the window convention (10
+    dispatches per sync). This adjudicates the tentpole at the op level
+    even if the round-level number moves for unrelated reasons, and is
+    the measured basis for docs/ROOFLINE.md's dropout-kernel section.
+
+    Returns (xla_ms / best_flash_ms speedup, per-config ms dict)."""
+    import jax
+    import jax.numpy as jnp
+
+    from commefficient_tpu.ops.flash_attention import flash_attention
+    from commefficient_tpu.ops.dropout import masked_dropout
+
+    R, H, D = 64, 12, 64        # W*B*C = 64 rows: the bench round's shape
+    rng = np.random.RandomState(0)
+    mk = lambda: jnp.asarray(rng.randn(R, T, H, D).astype(np.float32)
+                             ).astype(jnp.bfloat16)
+    q, k, v = mk(), mk(), mk()
+    key = jax.random.PRNGKey(0)
+    # the incumbent draws its mask bits through the rbg key exactly as
+    # FusedDropout(impl='xla_rbg') builds it (ops/dropout.py)
+    data = jnp.ravel(jax.random.key_data(key)).astype(jnp.uint32)
+    k4 = jnp.concatenate([data, data ^ jnp.uint32(0x9e3779b9)])[:4]
+    rbg_key = jax.random.wrap_key_data(k4, impl="rbg")
+
+    def timed_fwd_bwd(attn_fn, n_windows=3, n_steps=10):
+        g = jax.jit(jax.grad(
+            lambda q, k, v: jnp.sum(
+                attn_fn(q, k, v).astype(jnp.float32) ** 2),
+            argnums=(0, 1, 2)))
+        _sync(g(q, k, v)[0])  # compile
+        _sync(g(q, k, v)[0])  # warm
+        times = []
+        for _ in range(n_windows):
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(n_steps):
+                out = g(q, k, v)
+            _sync(out[0])
+            times.append((time.perf_counter() - t0) / n_steps)
+        return float(np.median(times))
+
+    def xla_full(q, k, v):
+        att = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D)
+        causal = jnp.tril(jnp.ones((T, T), bool))
+        att = att + jnp.where(causal, 0.0,
+                              jnp.finfo(att.dtype).min)[None, None]
+        att = jax.nn.softmax(att, axis=-1)
+        att = masked_dropout(att, rbg_key, rate)
+        return jnp.einsum("bhqk,bkhd->bqhd", att, v)
+
+    results = {}
+    for bq, bk in ((256, 256), (256, 128), (128, 256), (128, 128)):
+        t = timed_fwd_bwd(
+            lambda q, k, v, bq=bq, bk=bk: flash_attention(
+                q, k, v, block_q=bq, block_k=bk, dropout_rate=rate,
+                dropout_key=key))
+        results[f"flash_dropout_bq{bq}_bk{bk}_ms"] = round(t * 1e3, 3)
+    results["flash_nodropout_bq256_bk256_ms"] = round(
+        timed_fwd_bwd(lambda q, k, v: flash_attention(
+            q, k, v, block_q=256, block_k=256)) * 1e3, 3)
+    results["xla_full_prob_dropout_ms"] = round(
+        timed_fwd_bwd(xla_full) * 1e3, 3)
+    best = min(val for name, val in results.items()
+               if name.startswith("flash_dropout"))
+    results["best_flash_dropout_ms"] = best
+    return round(results["xla_full_prob_dropout_ms"] / best, 4), results
 
 
 def bench_gpt2_sketch_rounds(approx_recall=0.95, per_dispatch=True):
@@ -499,7 +583,15 @@ def main():
         gpt2 = run("gpt2_personachat_tokens_per_sec_chip", bench_gpt2_tokens)
         gpt2_flash = run(
             "gpt2_personachat_tokens_per_sec_chip_flash_attn",
-            lambda: bench_gpt2_tokens(attn_impl="blockwise"))
+            lambda: bench_gpt2_tokens(attn_impl="blockwise",
+                                      attn_dropout="kernel"))
+        gpt2_flash_512 = run(
+            "gpt2_personachat_tokens_per_sec_chip_T512_flash_attn",
+            lambda: bench_gpt2_tokens(attn_impl="blockwise", B=4, T=512,
+                                      attn_dropout="kernel",
+                                      per_dispatch=False))
+        flash_ab = run("flash_attn_t256_parity_dropout_kernel_ab",
+                       bench_flash_dropout_kernel_ab)
         sketch = run("gpt2_fetchsgd_sketch_rounds_per_sec",
                      bench_gpt2_sketch_rounds)
         sketch_exact = run(
@@ -542,9 +634,27 @@ def main():
     add("gpt2_personachat_tokens_per_sec_chip_flash_attn",
         round(gpt2_flash[0], 1) if gpt2_flash is not None else None,
         "tokens/sec",
-        {"attn_impl": "blockwise",
-         "note": "output-dropout instead of (T,T) prob masks — "
-                 "ROOFLINE.md dropout-tax A/B"})
+        {"attn_impl": "blockwise", "attn_dropout": "kernel",
+         "note": "in-kernel parity dropout (keep-bits from the core PRNG, "
+                 "regenerated in backward) — no (T,T) scores or masks in "
+                 "HBM; attn_dropout='kernel' raises rather than silently "
+                 "falling back, so this row IS the fused path"})
+    add("gpt2_personachat_tokens_per_sec_chip_T512_flash_attn",
+        round(gpt2_flash_512[0], 1) if gpt2_flash_512 is not None else None,
+        "tokens/sec",
+        {"attn_impl": "blockwise", "attn_dropout": "kernel",
+         "B": 4, "T": 512,
+         "note": "long-context federated row (16384 tokens/round, same as "
+                 "headline) at the T=512 crossover where ROOFLINE.md's "
+                 "sweep shows blockwise beating full (79.9k vs 66.9k)"})
+    add("flash_attn_t256_parity_dropout_kernel_ab",
+        round(flash_ab[0], 4) if flash_ab is not None else None,
+        "speedup_x",
+        dict(flash_ab[1], **{
+            "note": "fwd+bwd at R=64,H=12,D=64,T=256 bf16 rate=0.1: best "
+                    "flash block config vs XLA full attention with rbg "
+                    "prob dropout (the incumbent's exact math)"})
+        if flash_ab is not None else None)
     add("gpt2_fetchsgd_sketch_rounds_per_sec",
         round(sketch[0], 4) if sketch is not None else None, "rounds/sec",
         {"topk_approx_recall": 0.95,

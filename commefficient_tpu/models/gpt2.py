@@ -68,6 +68,18 @@ class GPT2Config:
         # Bernoulli distribution, ~8x cheaper bit generation on-chip; not
         # vmap-safe, so entrypoints only enable it on the fused round path)
         self.dropout_impl = "xla"
+        # Where attn_impl='blockwise' puts attention dropout:
+        #   'auto'   — reference-parity dropout on the attention
+        #              PROBABILITIES inside the fused kernel when the call
+        #              is kernel-eligible (TPU, causal self-attn), output
+        #              dropout otherwise (the pre-kernel fallback);
+        #   'output' — always output dropout (the old blockwise behavior);
+        #   'kernel' — require the in-kernel path; raises when training
+        #              with dropout>0 on an ineligible backend/shape
+        #              (bench uses this so an A/B can't silently mislabel).
+        # Irrelevant for attn_impl='full' (XLA prob dropout) and 'ring'
+        # (output dropout, documented divergence).
+        self.attn_dropout = "auto"
         # True: __call__ returns the final HIDDEN states (B, C, T, E)
         # instead of lm_logits, and the loss computes CE with the
         # vocab-chunked fused LM head (ops/fused_ce.py) — the (N, V)
@@ -109,11 +121,13 @@ class CausalSelfAttention(nn.Module):
     attn_block_size: int = 512
     seq_axis: str = "seq"
     dropout_impl: str = "xla"
+    attn_dropout: str = "auto"    # 'auto' | 'output' | 'kernel'
 
     @nn.compact
     def __call__(self, x, train: bool):
-        from commefficient_tpu.ops.attention import (blockwise_attention,
-                                                     ring_attention)
+        from commefficient_tpu.ops.attention import (
+            blockwise_attention, kernel_prob_dropout_eligible,
+            ring_attention)
         B, T, C = x.shape
         qkv = nn.Dense(3 * C, dtype=self.dtype,
                        kernel_init=nn.initializers.normal(0.02))(x)
@@ -125,13 +139,39 @@ class CausalSelfAttention(nn.Module):
             # never silently fall through to full attention
             raise ValueError(f"unknown attn_impl {self.attn_impl!r}")
         if self.attn_impl == "blockwise":
-            y = blockwise_attention(q, k, v, causal=True,
-                                    block_size=self.attn_block_size)
-            # flash-style impls don't support attention-prob dropout;
-            # apply it to the attention OUTPUT instead (documented
-            # divergence, ops/attention.py module docstring)
-            y = FusedDropout(self.dropout, self.dropout_impl)(
-                y, deterministic=not train)
+            if self.attn_dropout not in ("auto", "output", "kernel"):
+                raise ValueError(
+                    f"unknown attn_dropout {self.attn_dropout!r}")
+            rate = self.dropout if train else 0.0
+            in_kernel = (rate > 0.0 and self.attn_dropout != "output"
+                         and kernel_prob_dropout_eligible(q, k, v))
+            if self.attn_dropout == "kernel" and rate > 0.0 \
+                    and not in_kernel:
+                raise ValueError(
+                    "attn_dropout='kernel' but the fused kernel is not "
+                    "eligible for this backend/shape — use 'auto' to "
+                    "fall back to output dropout")
+            if in_kernel:
+                # reference-parity dropout on the attention PROBABILITIES,
+                # inside the fused kernel (ops/flash_attention.py): the
+                # keep-bits are drawn in-register per score tile and
+                # regenerated in the backward — no (T, T) mask in HBM.
+                # Flax's make_rng folds in the module path, so each layer
+                # draws an independent mask from the round's dropout rng.
+                y = blockwise_attention(
+                    q, k, v, causal=True,
+                    block_size=self.attn_block_size,
+                    dropout_rate=rate,
+                    dropout_rng=self.make_rng("dropout"))
+            else:
+                y = blockwise_attention(q, k, v, causal=True,
+                                        block_size=self.attn_block_size)
+                # off-kernel fallback: dropout on the attention OUTPUT
+                # (documented divergence, ops/attention.py module
+                # docstring — the scan path can't drop probabilities
+                # without materializing the mask)
+                y = FusedDropout(self.dropout, self.dropout_impl)(
+                    y, deterministic=not train)
         elif self.attn_impl == "ring":
             # requires tracing inside shard_map with T sharded on seq_axis
             y = ring_attention(q, k, v, axis_name=self.seq_axis, causal=True)
@@ -173,6 +213,7 @@ class Block(nn.Module):
     moe_capacity_factor: float = 1.25
     post_ln: bool = False    # GPT-1 places LN after the residual add
     dropout_impl: str = "xla"
+    attn_dropout: str = "auto"
 
     def _mlp(self, h, train: bool):
         if self.moe_experts > 0:
@@ -194,7 +235,8 @@ class Block(nn.Module):
         attn = CausalSelfAttention(self.n_head, self.dropout,
                                    self.dtype, self.attn_impl,
                                    self.attn_block_size, self.seq_axis,
-                                   self.dropout_impl)
+                                   self.dropout_impl,
+                                   attn_dropout=self.attn_dropout)
         drop = lambda t: FusedDropout(self.dropout, self.dropout_impl,
                                       name="mlp_drop")(
             t, deterministic=not train)
@@ -250,7 +292,8 @@ class GPT2DoubleHeads(nn.Module):
                           cfg.attn_impl, cfg.attn_block_size,
                           cfg.seq_axis, cfg.moe_experts,
                           cfg.moe_capacity_factor, post_ln,
-                          cfg.dropout_impl)(x, train)
+                          cfg.dropout_impl,
+                          getattr(cfg, "attn_dropout", "auto"))(x, train)
         x = x.astype(jnp.float32)
         if not post_ln:
             x = nn.LayerNorm(epsilon=1e-5)(x)   # GPT-1 has no final LN

@@ -21,9 +21,14 @@ long-context is a first-class capability:
 
 Both are numerically equivalent (<=1e-5 f32) to full attention — tested
 against ``full_attention`` on an 8-device CPU mesh in
-tests/test_attention.py. Attention-probability dropout is deliberately
-not supported here (flash-style recomputation and prob-dropout do not
-compose); GPT2 applies output dropout instead when these impls are on.
+tests/test_attention.py. Attention-probability dropout is supported on
+the fused-kernel path only (``blockwise_attention(dropout_rate=...,
+dropout_rng=...)`` — keep-bits drawn in-register per score tile,
+regenerated bit-identically in the backward; ops/flash_attention.py).
+The scan and ring formulations still do not compose with prob-dropout
+(XLA recomputes nothing, so the mask would have to materialize at
+O(T^2)); callers that need dropout off-kernel apply output dropout
+instead (models/gpt2.py's fallback).
 
 Layout: q/k/v are (B, T, H, D); causal masking uses GLOBAL positions, so
 shards mask correctly wherever they sit in the ring. ``kv_mask`` (B, T)
@@ -122,10 +127,28 @@ def _finish(m, l, o, dtype):
     return (o / l.transpose(0, 2, 1)[..., None]).astype(dtype)
 
 
+def kernel_prob_dropout_eligible(q, k, v, *, causal: bool = True,
+                                 kv_mask: Optional[jax.Array] = None) -> bool:
+    """True when ``blockwise_attention`` would auto-dispatch the fused
+    kernel for this call — i.e. when in-kernel attention-probability
+    dropout is available. The model layer keys its dropout placement off
+    this (in-kernel prob dropout when eligible, output dropout otherwise)
+    so eligibility logic lives in exactly one place."""
+    from commefficient_tpu.ops import flash_attention as _fa
+    # allowlist: the tunneled chip's backend can report 'tpu' or 'axon'
+    return (_fa.supported(q, k, v, causal, kv_mask)
+            and jax.default_backend() in ("tpu", "axon"))
+
+
 def blockwise_attention(q, k, v, *, causal: bool = True,
                         kv_mask: Optional[jax.Array] = None,
                         block_size: int = 512,
-                        use_kernel: Optional[bool] = None) -> jax.Array:
+                        use_kernel: Optional[bool] = None,
+                        dropout_rate: float = 0.0,
+                        dropout_rng: Optional[jax.Array] = None,
+                        block_q: Optional[int] = None,
+                        block_k: Optional[int] = None,
+                        interpret: bool = False) -> jax.Array:
     """Flash-style attention: O(T*block) memory on any backend.
 
     On TPU, calls the fused Pallas kernel (ops/flash_attention.py — 3.1x
@@ -133,18 +156,38 @@ def blockwise_attention(q, k, v, *, causal: bool = True,
     kernel-supported (causal self-attention, no kv_mask); otherwise scans
     over key/value blocks with the same online softmax. ``use_kernel``
     forces the choice (None = auto); ``block_size`` applies to the scan
-    path only — the kernel picks its own swept block sizes."""
+    path only — the kernel uses its swept defaults unless
+    ``block_q``/``block_k`` override them (the bench's T=256 sweep).
+
+    ``dropout_rate > 0`` applies reference-parity Bernoulli dropout to
+    the attention probabilities INSIDE the kernel, seeded from
+    ``dropout_rng`` — kernel path only: the scan formulation raises,
+    because supporting it would mean materializing the O(T^2) mask this
+    module exists to avoid. ``interpret`` runs the kernel in the Pallas
+    interpreter (CPU tests)."""
     from commefficient_tpu.ops import flash_attention as _fa
     if use_kernel is None:
-        # allowlist: the tunneled chip's backend can report 'tpu' or 'axon'
-        use_kernel = (_fa.supported(q, k, v, causal, kv_mask)
-                      and jax.default_backend() in ("tpu", "axon"))
+        use_kernel = kernel_prob_dropout_eligible(q, k, v, causal=causal,
+                                                  kv_mask=kv_mask)
     if use_kernel:
         if not _fa.supported(q, k, v, causal, kv_mask):
             raise ValueError(
                 "use_kernel=True but the call is not kernel-supported "
                 "(needs causal self-attention without kv_mask)")
-        return _fa.flash_attention(q, k, v, causal=causal)
+        kw = {}
+        if block_q is not None:
+            kw["block_q"] = block_q
+        if block_k is not None:
+            kw["block_k"] = block_k
+        return _fa.flash_attention(q, k, v, causal=causal,
+                                   dropout_rate=dropout_rate,
+                                   dropout_key=dropout_rng,
+                                   interpret=interpret, **kw)
+    if dropout_rate > 0.0:
+        raise ValueError(
+            "attention-probability dropout needs the fused kernel path "
+            "(the scan formulation would materialize the (T, T) mask); "
+            "use output dropout on this backend/shape instead")
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     bs = min(block_size, Tk)

@@ -122,6 +122,17 @@ def build_parser(default_lr: float = 0.4) -> argparse.ArgumentParser:
                         "federated GPT2 bench, same Bernoulli "
                         "distribution); 'xla' is the portable threefry "
                         "path")
+    p.add_argument("--attn_dropout", choices=("auto", "output", "kernel"),
+                   default="auto",
+                   help="attention-dropout placement for --attn_impl "
+                        "blockwise: 'auto' uses reference-parity in-kernel "
+                        "dropout on the attention probabilities when the "
+                        "fused flash kernel is eligible (TPU, causal "
+                        "self-attn; ops/flash_attention.py) and falls back "
+                        "to output dropout otherwise; 'output' forces the "
+                        "pre-kernel output-dropout behavior; 'kernel' "
+                        "requires the in-kernel path and errors when "
+                        "ineligible (bench/A-B use)")
     p.add_argument("--fused_lm_head", action="store_true",
                    help="compute the GPT2 LM loss with the vocab-chunked "
                         "fused head+CE (ops/fused_ce.py): the (tokens, "

@@ -88,6 +88,10 @@ def train(args, mesh=None, max_rounds=None, log=True):
     gcfg.dtype = getattr(args, "compute_dtype", "float32")
     # hardware-RNG dropout bits / fused LM-head CE (see args.py help)
     gcfg.dropout_impl = getattr(args, "dropout_impl", "xla")
+    # blockwise attention-dropout placement: in-kernel parity prob
+    # dropout when eligible ('auto'), forced output dropout, or
+    # loud-failure 'kernel' (see args.py help / models/gpt2.py)
+    gcfg.attn_dropout = getattr(args, "attn_dropout", "auto")
     gcfg.fused_lm_head = bool(getattr(args, "fused_lm_head", False))
     gcfg.moe_experts = int(getattr(args, "moe_experts", 0) or 0)
     gcfg.moe_capacity_factor = float(getattr(args, "moe_capacity_factor",

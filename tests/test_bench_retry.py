@@ -109,8 +109,15 @@ def test_main_emits_json_and_exits_zero_despite_failed_metrics(
                         lambda approx_recall=0.95:
                         (2.5, {"topk_approx_recall": approx_recall,
                                "round_throughput_ms": 400.0}))
-    monkeypatch.setattr(bench, "bench_gpt2_tokens",
-                        lambda attn_impl="full": (1000.0, 900.0))
+    monkeypatch.setattr(
+        bench, "bench_gpt2_tokens",
+        lambda attn_impl="full", B=8, T=256, attn_dropout="auto",
+        per_dispatch=True: (1000.0, 900.0 if per_dispatch else None))
+    monkeypatch.setattr(
+        bench, "bench_flash_dropout_kernel_ab",
+        lambda: (1.3, {"flash_dropout_bq256_bk256_ms": 8.0,
+                       "xla_full_prob_dropout_ms": 10.4,
+                       "best_flash_dropout_ms": 8.0}))
 
     def dead(*a, **k):
         raise RuntimeError("UNAVAILABLE: tunnel read body")
