@@ -26,6 +26,46 @@ import time
 
 import numpy as np
 
+#: --dry-run: every bench row builds its real setup (model, learner,
+#: device batch) and TRACES its jitted programs via jax.eval_shape, then
+#: returns without compiling or timing. Signature drift, shape bugs and
+#: config rot — the class of failure that silently zeroed the round-5
+#: bench artifact — surface at trace time, so tier-1 catches them
+#: (tests/test_bench_dry_run.py) instead of the next capture session.
+DRY_RUN = False
+
+
+def _dry_trace_round(learner, ids_fn, batch, mask, scan_rounds=None):
+    """Trace the learner's jitted round — and, when ``scan_rounds`` is
+    given, the K-round scan dispatch — without compiling. Exercises the
+    exact argument plumbing the timed path uses (offload rows included),
+    so a drifted signature or dtype fails here like it would on-chip."""
+    import jax
+    import jax.numpy as jnp
+
+    ids = jnp.asarray(ids_fn(0), jnp.int32)
+    cols = tuple(jnp.asarray(t) for t in batch)
+    m = jnp.asarray(mask, jnp.float32)
+    lr = jnp.float32(learner.lr_at(0.0))
+    rng = jax.random.PRNGKey(0)
+    if learner._offload:
+        rows = learner._offload_pipe.gather(
+            np.asarray(ids_fn(0)).astype(np.int64))
+        out = jax.eval_shape(learner._round, learner.state, rows, ids,
+                             cols, m, lr, rng)
+    else:
+        out = jax.eval_shape(learner._round, learner.state, ids, cols, m,
+                             lr, rng)
+    if scan_rounds:
+        K = scan_rounds
+        ids_k = jnp.broadcast_to(ids, (K,) + ids.shape)
+        cols_k = tuple(jnp.broadcast_to(c, (K,) + c.shape) for c in cols)
+        mask_k = jnp.broadcast_to(m, (K,) + m.shape)
+        jax.eval_shape(learner._rounds_scan_fn(), learner.state, ids_k,
+                       cols_k, mask_k, jnp.zeros((K,), jnp.float32),
+                       jnp.stack([rng] * K))
+    return {"dry_run": "ok", "out_leaves": len(jax.tree.leaves(out))}
+
 
 def _sync(x):
     """Force completion. block_until_ready is a no-op on the axon platform,
@@ -92,6 +132,18 @@ def bench_cifar_sketch(approx_recall=0.95):
 
     def one_round(r):
         return learner.train_round_async(ids_fn(r), (imgs_d, tgts_d), mask_d)
+
+    if DRY_RUN:
+        # trace the sketch component ops too — the breakdown section
+        # dispatches them standalone with use_kernel=True
+        from commefficient_tpu.federated.server import make_sketch
+        cs = make_sketch(learner.cfg)
+        vec = jax.ShapeDtypeStruct((learner.cfg.grad_size,), jnp.float32)
+        table = jax.eval_shape(lambda v: cs.sketch_vec(v, True), vec)
+        jax.eval_shape(lambda t: cs.unsketch(t, cfg.k, approx_recall or None,
+                                             True), table)
+        return _dry_trace_round(learner, ids_fn, (imgs_d, tgts_d), mask_d,
+                                scan_rounds=12), {}
 
     # Headline metric = steady-state THROUGHPUT: 12-round windows, one
     # metric sync per window, each window dispatched as ONE traced
@@ -171,6 +223,13 @@ def _gpt2_fed_setup(B=8, attn_impl="full", dropout_impl="xla_rbg",
     gcfg.attn_impl = attn_impl
     gcfg.attn_block_size = min(256, T)
     gcfg.attn_dropout = attn_dropout
+    if DRY_RUN and attn_dropout == "kernel" \
+            and jax.default_backend() != "tpu":
+        # --dry-run validates shapes/signatures on whatever host runs it;
+        # the in-kernel dropout path is TPU-only and 'kernel' rightly
+        # raises off-TPU. 'auto' traces the same blockwise program with
+        # output dropout; timed runs (and TPU dry-runs) stay strict.
+        gcfg.attn_dropout = "auto"
     # 'xla_rbg' dropout: reference-parity Bernoulli masks (attn_pdrop on
     # the probabilities) with bits drawn by the TPU hardware RngBitGenerator
     # instead of threefry — ~2x cheaper generation, same fusion behavior
@@ -277,6 +336,9 @@ def bench_gpt2_tokens(attn_impl="full", B=8, T=256, attn_dropout="auto",
         _gpt2_fed_setup(attn_impl=attn_impl, B=B, T=T,
                         attn_dropout=attn_dropout, mode="uncompressed",
                         error_type="none")
+    if DRY_RUN:
+        return _dry_trace_round(learner, ids_fn, batch, mask,
+                                scan_rounds=12), None
     pd = (tokens_per_round / _timed_windows(learner, one_round)
           if per_dispatch else None)
     scanned = tokens_per_round / _timed_scan_windows(
@@ -320,6 +382,9 @@ def bench_flash_dropout_kernel_ab(T=256, rate=0.1):
             lambda q, k, v: jnp.sum(
                 attn_fn(q, k, v).astype(jnp.float32) ** 2),
             argnums=(0, 1, 2)))
+        if DRY_RUN:
+            jax.eval_shape(g, q, k, v)
+            return float("nan")
         _sync(g(q, k, v)[0])  # compile
         _sync(g(q, k, v)[0])  # warm
         times = []
@@ -353,6 +418,8 @@ def bench_flash_dropout_kernel_ab(T=256, rate=0.1):
             q, k, v, block_q=256, block_k=256)) * 1e3, 3)
     results["xla_full_prob_dropout_ms"] = round(
         timed_fwd_bwd(xla_full) * 1e3, 3)
+    if DRY_RUN:   # every config traced (values are NaN placeholders)
+        return {"dry_run": "ok", "configs": len(results)}, results
     best = min(val for name, val in results.items()
                if name.startswith("flash_dropout"))
     results["best_flash_dropout_ms"] = best
@@ -373,6 +440,9 @@ def bench_gpt2_sketch_rounds(approx_recall=0.95, per_dispatch=True):
     learner, one_round, _, (batch, mask, ids_fn) = _gpt2_fed_setup(
         B=4, mode="sketch", error_type="virtual", k=50_000, num_rows=5,
         num_cols=500_000, topk_approx_recall=approx_recall)
+    if DRY_RUN:
+        return _dry_trace_round(learner, ids_fn, batch, mask,
+                                scan_rounds=6), None
     # BOTH measurement conventions (ADVICE r4): rounds 1-3 reported
     # per-round dispatch; round 4 switched the headline to scan windows —
     # emitting the per-dispatch companion keeps history comparable.
@@ -410,8 +480,15 @@ def bench_longcontext_tokens():
     types = jnp.asarray(rng.randint(0, 3, (B, 1, T)).astype(np.int32))
     mc = jnp.full((B, 1), T - 1, jnp.int32)
     labels = jnp.asarray(rng.randint(0, 50000, (B, 1, T)).astype(np.int32))
-    params = model.init(jax.random.PRNGKey(0), ids, types, mc,
-                        train=False)["params"]
+    if DRY_RUN:
+        # even the init is traced, not run — gpt2-small at T=4096 has no
+        # business executing a forward pass during a smoke check
+        params = jax.eval_shape(
+            lambda r: model.init(r, ids, types, mc, train=False),
+            jax.random.PRNGKey(0))["params"]
+    else:
+        params = model.init(jax.random.PRNGKey(0), ids, types, mc,
+                            train=False)["params"]
 
     # labels shifted instead of slicing logits[:-1]: the sliced logits'
     # backward would materialize a (B, T, V) 3.3 GB pad (losses.py note)
@@ -425,6 +502,11 @@ def bench_longcontext_tokens():
             picked = jnp.take_along_axis(lp, tgt[..., None], axis=-1)
             return -jnp.mean(picked[:, :-1])
         return jax.grad(loss_fn)(p)
+
+    if DRY_RUN:
+        out = jax.eval_shape(step, params)
+        return {"dry_run": "ok",
+                "grad_leaves": len(jax.tree.leaves(out))}
 
     # steady-state throughput, same convention as the federated metrics:
     # dispatch a window of steps back-to-back, sync once — the per-dispatch
@@ -480,6 +562,9 @@ def bench_offload_overlap(n_rounds=8):
 
     def ids_fn(r):
         return (np.arange(W) + r * W) % N
+
+    if DRY_RUN:
+        return _dry_trace_round(make_learner(), ids_fn, batch, mask)
 
     # sync convention: train_round flushes the pipeline every round, so
     # gather/compute/scatter serialize — the pre-pipeline critical path
@@ -561,13 +646,82 @@ def _run_metric(name, fn, errors, retries=2):
             return None
 
 
+def _bench_rows():
+    """Every bench row, as (name, zero-arg closure) pairs — the single
+    registry both the timed JSON path and ``--dry-run`` iterate, so a row
+    can't exist in one mode and silently be skipped by the other.
+    Late-bound so monkeypatched bench_* fns (tests) are picked up."""
+    return [
+        ("cifar10_resnet9_fed_rounds_per_sec",
+         lambda: bench_cifar_sketch()),
+        ("cifar10_resnet9_fed_rounds_per_sec_exact_topk",
+         lambda: bench_cifar_sketch(approx_recall=0.0)),
+        ("gpt2_personachat_tokens_per_sec_chip",
+         lambda: bench_gpt2_tokens()),
+        ("gpt2_personachat_tokens_per_sec_chip_flash_attn",
+         lambda: bench_gpt2_tokens(attn_impl="blockwise",
+                                   attn_dropout="kernel")),
+        ("gpt2_personachat_tokens_per_sec_chip_T512_flash_attn",
+         lambda: bench_gpt2_tokens(attn_impl="blockwise", B=4, T=512,
+                                   attn_dropout="kernel",
+                                   per_dispatch=False)),
+        ("flash_attn_t256_parity_dropout_kernel_ab",
+         lambda: bench_flash_dropout_kernel_ab()),
+        ("gpt2_fetchsgd_sketch_rounds_per_sec",
+         lambda: bench_gpt2_sketch_rounds()),
+        ("gpt2_fetchsgd_sketch_rounds_per_sec_exact_topk",
+         lambda: bench_gpt2_sketch_rounds(approx_recall=0.0,
+                                          per_dispatch=False)),
+        ("gpt2_longcontext_4k_blockwise_tokens_per_sec_chip",
+         lambda: bench_longcontext_tokens()),
+        ("offload_gather_scatter_overlap",
+         lambda: bench_offload_overlap()),
+    ]
+
+
+def _dry_run_main(row_filter=""):
+    """``--dry-run``: build every (selected) row's real setup and trace
+    its jitted programs without compiling or timing. Prints one status
+    line per row; returns the number of rows that failed to trace."""
+    global DRY_RUN
+    DRY_RUN = True
+    sel = [s for s in row_filter.split(",") if s]
+    failed = 0
+    try:
+        for name, fn in _bench_rows():
+            if sel and not any(s in name for s in sel):
+                continue
+            t0 = time.perf_counter()
+            try:
+                fn()
+                print(f"dry-run ok   {name} "
+                      f"({time.perf_counter() - t0:.1f}s)")
+            except Exception as exc:  # noqa: BLE001 — report every row
+                failed += 1
+                print(f"dry-run FAIL {name}: "
+                      f"{type(exc).__name__}: {exc}")
+    finally:
+        DRY_RUN = False
+    return failed
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--profile", default=None,
                     help="directory for a jax.profiler trace of the bench")
     ap.add_argument("--retries", type=int, default=2,
                     help="re-runs per metric on transient tunnel errors")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="build every row's setup and trace its jitted "
+                         "programs (jax.eval_shape) without compiling or "
+                         "timing; exits nonzero if any row fails to trace")
+    ap.add_argument("--rows", default="",
+                    help="comma-separated substrings selecting rows "
+                         "(--dry-run only)")
     args = ap.parse_args()
+
+    if args.dry_run:
+        raise SystemExit(1 if _dry_run_main(args.rows) else 0)
 
     from commefficient_tpu.utils.logging import profile_ctx
 
@@ -577,31 +731,17 @@ def main():
         return _run_metric(name, fn, errors, retries=args.retries)
 
     with profile_ctx(args.profile):
-        cifar = run("cifar10_resnet9_fed_rounds_per_sec", bench_cifar_sketch)
-        cifar_exact = run("cifar10_resnet9_fed_rounds_per_sec_exact_topk",
-                          lambda: bench_cifar_sketch(approx_recall=0.0))
-        gpt2 = run("gpt2_personachat_tokens_per_sec_chip", bench_gpt2_tokens)
-        gpt2_flash = run(
-            "gpt2_personachat_tokens_per_sec_chip_flash_attn",
-            lambda: bench_gpt2_tokens(attn_impl="blockwise",
-                                      attn_dropout="kernel"))
-        gpt2_flash_512 = run(
-            "gpt2_personachat_tokens_per_sec_chip_T512_flash_attn",
-            lambda: bench_gpt2_tokens(attn_impl="blockwise", B=4, T=512,
-                                      attn_dropout="kernel",
-                                      per_dispatch=False))
-        flash_ab = run("flash_attn_t256_parity_dropout_kernel_ab",
-                       bench_flash_dropout_kernel_ab)
-        sketch = run("gpt2_fetchsgd_sketch_rounds_per_sec",
-                     bench_gpt2_sketch_rounds)
-        sketch_exact = run(
-            "gpt2_fetchsgd_sketch_rounds_per_sec_exact_topk",
-            lambda: bench_gpt2_sketch_rounds(approx_recall=0.0,
-                                             per_dispatch=False))
-        longctx = run("gpt2_longcontext_4k_blockwise_tokens_per_sec_chip",
-                      bench_longcontext_tokens)
-        offload = run("offload_gather_scatter_overlap",
-                      bench_offload_overlap)
+        res = {name: run(name, fn) for name, fn in _bench_rows()}
+    cifar = res["cifar10_resnet9_fed_rounds_per_sec"]
+    cifar_exact = res["cifar10_resnet9_fed_rounds_per_sec_exact_topk"]
+    gpt2 = res["gpt2_personachat_tokens_per_sec_chip"]
+    gpt2_flash = res["gpt2_personachat_tokens_per_sec_chip_flash_attn"]
+    gpt2_flash_512 = res["gpt2_personachat_tokens_per_sec_chip_T512_flash_attn"]
+    flash_ab = res["flash_attn_t256_parity_dropout_kernel_ab"]
+    sketch = res["gpt2_fetchsgd_sketch_rounds_per_sec"]
+    sketch_exact = res["gpt2_fetchsgd_sketch_rounds_per_sec_exact_topk"]
+    longctx = res["gpt2_longcontext_4k_blockwise_tokens_per_sec_chip"]
+    offload = res["offload_gather_scatter_overlap"]
 
     rounds_per_sec, breakdown = cifar if cifar is not None else (None, {})
     config = {"topk_approx_recall": breakdown.pop("topk_approx_recall")} \

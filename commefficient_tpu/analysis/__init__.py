@@ -1,0 +1,72 @@
+"""graft-audit: static analysis of the repo's jaxpr-level invariants.
+
+The properties this repo's performance story rests on — no dense
+``(num_clients, d)`` client matrix, no ``(W, d)`` accounting
+changed-matrix, no materialized ``(B, H, T, T)`` attention scores, no
+host round-trips inside the jitted round, no silent retraces — are
+*structural* facts about traced programs, so they can be machine-checked
+instead of asserted in comments.  This package does that three ways:
+
+- library: ``analysis.audit(fn, *args, dims=..., rules=...)`` traces
+  ``fn`` and returns a structured :class:`~.report.AuditReport`;
+- CLI: ``python -m commefficient_tpu.analysis --target round`` (also
+  the ``graft-audit`` console script) prints per-rule reports and exits
+  non-zero on any violation;
+- pytest: ``tests/test_analysis_audits.py`` runs every target as a
+  tier-1 test under the ``audit`` marker.
+
+See ``docs/ANALYSIS.md`` for the rule catalog and how to add/allowlist.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+
+from .prng_lint import lint_paths
+from .report import AuditReport, format_reports
+from .retrace import check_retrace
+from .rules import (DEFAULT_PATTERNS, DTYPE_ALLOW_PRIMITIVES,
+                    HOST_BOUNDARY_PRIMITIVES, SCATTER_PRIMITIVES, DtypeRule,
+                    FootprintRule, RuleReport, ShapePattern, TransferRule,
+                    Violation)
+from .targets import AuditTarget, build_targets
+from .walker import EqnSite, WalkStats, collect_shapes, iter_eqns, walk
+
+__all__ = [
+    "AuditReport", "AuditTarget", "DtypeRule", "EqnSite", "FootprintRule",
+    "RuleReport", "ShapePattern", "TransferRule", "Violation", "WalkStats",
+    "audit", "build_targets", "check_retrace", "collect_shapes",
+    "format_reports", "iter_eqns", "lint_paths", "walk",
+    "DEFAULT_PATTERNS", "DTYPE_ALLOW_PRIMITIVES",
+    "HOST_BOUNDARY_PRIMITIVES", "SCATTER_PRIMITIVES",
+]
+
+
+def default_rules(bf16: bool = False) -> tuple:
+    rules = (FootprintRule(DEFAULT_PATTERNS), TransferRule())
+    if bf16:
+        rules = rules + (DtypeRule(),)
+    return rules
+
+
+def audit(fn, *args, dims: Optional[dict] = None,
+          rules: Optional[Sequence] = None, bf16: bool = False,
+          name: str = "", **kwargs) -> AuditReport:
+    """Trace ``fn(*args, **kwargs)`` and check every rule over every eqn,
+    including ``scan``/``cond``/``while``/``pjit``/``custom_vjp``/
+    ``custom_jvp``/``remat`` sub-jaxprs.
+
+    ``dims`` binds the symbolic footprint dimensions (``num_clients``,
+    ``d``, ``W``, ``B``, ``H``, ``T``); patterns with unbound symbols
+    are inactive.  ``bf16=True`` adds the dtype-policy rule (only
+    meaningful for programs that declare bf16 compute).
+    """
+    closed = jax.make_jaxpr(lambda *a: fn(*a, **kwargs))(*args)
+    sites, stats = walk(closed)
+    report = AuditReport(target=name or getattr(fn, "__name__", "audit"),
+                         stats=stats)
+    for rule in (rules if rules is not None else default_rules(bf16)):
+        report.rule_reports.append(rule.check(sites, stats, dims or {}))
+    return report

@@ -1,0 +1,60 @@
+"""graft-audit CLI: trace the repo's production programs and enforce the
+jaxpr-level invariants.
+
+    python -m commefficient_tpu.analysis --target round
+    python -m commefficient_tpu.analysis --target all --prng-lint
+    graft-audit --target all            # console script (pyproject.toml)
+
+Exit status is non-zero on any violation, so this is the CI gate.
+Runs on CPU (forced below — tracing is platform-independent and the
+retrace checks only need a compile cache, not a fast one).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="graft-audit",
+        description="jaxpr-level invariant auditor (footprint / transfer / "
+                    "retrace / dtype / prng)")
+    parser.add_argument("--target", default="all",
+                        choices=["round", "gpt2", "attention", "sketch",
+                                 "all"])
+    parser.add_argument("--no-retrace", action="store_true",
+                        help="skip the (compile-heavy) retrace guards")
+    parser.add_argument("--prng-lint", action="store_true",
+                        help="also run the AST-level PRNG hygiene lint "
+                             "over models/, federated/, ops/")
+    parser.add_argument("--verbose", action="store_true",
+                        help="include per-rule notes (bound patterns, "
+                             "forbidden primitive sets)")
+    args = parser.parse_args(argv)
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from . import build_targets, format_reports, lint_paths
+
+    reports = [t.audit(with_retrace=not args.no_retrace)
+               for t in build_targets(args.target)]
+    print(format_reports(reports, verbose=args.verbose))
+
+    ok = all(r.ok for r in reports)
+    if args.prng_lint:
+        pkg = Path(__file__).resolve().parent.parent
+        lint = lint_paths([pkg / "models", pkg / "federated", pkg / "ops"])
+        mark = "ok " if lint.ok else "FAIL"
+        print(f"[{mark}] prng       ({lint.notes})")
+        for v in lint.violations:
+            print(f"       - {v}")
+        ok = ok and lint.ok
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

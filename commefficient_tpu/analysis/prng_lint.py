@@ -1,0 +1,199 @@
+"""AST-level PRNG hygiene lint: the same key consumed twice.
+
+Consuming one PRNG key in two different samplers silently correlates
+draws that the math assumes independent — the classic federated bug is
+client ``i``'s dropout mask equalling its data-noise mask.  This lint
+walks the source of ``models/``, ``federated/`` and ``ops/`` and flags
+any function in which the *same key name* reaches two sampler calls
+without an intervening ``split`` / ``fold_in`` rebind.
+
+Scope and precision (deliberately modest — this is a lint, not an
+interpreter):
+
+- **Samplers consume**; ``split``/``fold_in``/``clone``/``key_data``
+  derive and do not.  Two ``fold_in(key, i)`` calls with different data
+  are the repo's standard derivation idiom and are never flagged.
+- **Branch-aware**: consumptions on mutually exclusive ``if``/``else``
+  paths don't conflict, and a branch ending in ``return``/``raise``
+  does not flow into the statements after it (``ops/dropout.py``'s
+  early-return rbg path is the motivating case).
+- **Loop-aware**: loop bodies are walked twice, so a key created
+  *outside* a loop and consumed inside it without per-iteration
+  rebinding is flagged (the ``gpt2_generate`` decode loop passes
+  because it splits every step).
+- A trailing ``# prng-ok`` comment on the consumption line suppresses
+  the finding, for deliberate reuse (e.g. recompute-style dropout that
+  *wants* the identical mask twice).
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterable, Sequence
+
+from .rules import RuleReport, Violation
+
+SAMPLERS = frozenset({
+    "normal", "uniform", "bernoulli", "bits", "randint", "permutation",
+    "categorical", "gumbel", "exponential", "truncated_normal", "choice",
+    "laplace", "cauchy", "beta", "gamma", "poisson", "dirichlet",
+    "shuffle", "rademacher", "orthogonal", "ball", "t", "loggamma",
+})
+DERIVERS = frozenset({"split", "fold_in", "clone", "wrap_key_data",
+                      "PRNGKey", "key", "key_data"})
+PRAGMA = "# prng-ok"
+
+
+def _call_name(node: ast.Call) -> str:
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return ""
+
+
+def _is_random_call(node: ast.Call) -> bool:
+    """True for ``jax.random.X(...)`` / ``jrandom.X(...)`` / ``random.X``."""
+    fn = node.func
+    if not isinstance(fn, ast.Attribute):
+        return _call_name(node) in ("PRNGKey",)
+    base = fn.value
+    base_name = ""
+    if isinstance(base, ast.Attribute):
+        base_name = base.attr
+    elif isinstance(base, ast.Name):
+        base_name = base.id
+    return "random" in base_name or base_name in ("jr", "jrandom")
+
+
+class _FnLinter:
+    def __init__(self, fname: str, source_lines: Sequence[str]):
+        self.fname = fname
+        self.lines = source_lines
+        self.violations: list = []
+        self._seen_nodes: set = set()
+
+    def _suppressed(self, node: ast.AST) -> bool:
+        line = self.lines[node.lineno - 1] if node.lineno <= len(self.lines) \
+            else ""
+        return PRAGMA in line
+
+    def _flag(self, name: str, first: ast.AST, second: ast.AST):
+        if id(second) in self._seen_nodes:
+            return
+        self._seen_nodes.add(id(second))
+        if self._suppressed(second) or self._suppressed(first):
+            return
+        self.violations.append(Violation(
+            rule="prng", primitive="jax.random",
+            path=f"{self.fname}:{second.lineno}",
+            message=f"key '{name}' consumed again (first use at line "
+                    f"{first.lineno}) without split/fold_in"))
+
+    # -- expression scan: consumptions + derivations inside one stmt ----
+
+    def _scan_expr(self, node: ast.AST, consumed: dict):
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call) or not _is_random_call(sub):
+                continue
+            name = _call_name(sub)
+            if name in SAMPLERS and sub.args and \
+                    isinstance(sub.args[0], ast.Name):
+                key = sub.args[0].id
+                if key in consumed:
+                    self._flag(key, consumed[key], sub)
+                else:
+                    consumed[key] = sub
+
+    # -- statement walk with branch/termination awareness ---------------
+
+    def _rebind_targets(self, targets: Iterable[ast.AST], consumed: dict):
+        for tgt in targets:
+            for sub in ast.walk(tgt):
+                if isinstance(sub, ast.Name):
+                    consumed.pop(sub.id, None)
+
+    def walk_block(self, stmts: Sequence[ast.stmt], consumed: dict) -> bool:
+        """Returns True if the block always terminates (return/raise)."""
+        for stmt in stmts:
+            if isinstance(stmt, (ast.Return, ast.Raise)):
+                self._scan_expr(stmt, consumed)
+                return True
+            if isinstance(stmt, (ast.Break, ast.Continue)):
+                return False
+            if isinstance(stmt, ast.If):
+                self._scan_expr(stmt.test, consumed)
+                body_c = dict(consumed)
+                body_term = self.walk_block(stmt.body, body_c)
+                else_c = dict(consumed)
+                else_term = self.walk_block(stmt.orelse, else_c)
+                if body_term and else_term:
+                    return True
+                if body_term:
+                    consumed.clear(); consumed.update(else_c)
+                elif else_term:
+                    consumed.clear(); consumed.update(body_c)
+                else:
+                    consumed.clear()
+                    consumed.update(else_c)
+                    consumed.update(body_c)
+                continue
+            if isinstance(stmt, (ast.For, ast.While)):
+                if isinstance(stmt, ast.For):
+                    self._scan_expr(stmt.iter, consumed)
+                    self._rebind_targets([stmt.target], consumed)
+                else:
+                    self._scan_expr(stmt.test, consumed)
+                # two symbolic iterations: reuse across iterations of a
+                # key bound outside the loop shows up on pass 2.
+                self.walk_block(stmt.body, consumed)
+                self.walk_block(stmt.body, consumed)
+                self.walk_block(stmt.orelse, consumed)
+                continue
+            if isinstance(stmt, (ast.With, ast.Try)):
+                for item in getattr(stmt, "items", []):
+                    self._scan_expr(item.context_expr, consumed)
+                self.walk_block(stmt.body, consumed)
+                for handler in getattr(stmt, "handlers", []):
+                    self.walk_block(handler.body, dict(consumed))
+                self.walk_block(getattr(stmt, "finalbody", []), consumed)
+                self.walk_block(getattr(stmt, "orelse", []), consumed)
+                continue
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.lint_function(stmt)   # nested fn: fresh scope
+                continue
+            if isinstance(stmt, ast.ClassDef):
+                self.walk_block(stmt.body, {})
+                continue
+            # plain statement: scan expressions, then apply rebinds
+            self._scan_expr(stmt, consumed)
+            if isinstance(stmt, ast.Assign):
+                self._rebind_targets(stmt.targets, consumed)
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                self._rebind_targets([stmt.target], consumed)
+        return False
+
+    def lint_function(self, fn: ast.AST):
+        self.walk_block(fn.body, {})
+
+
+def lint_paths(paths: Iterable[Path]) -> RuleReport:
+    report = RuleReport(rule="prng", ok=True)
+    files = 0
+    for path in paths:
+        path = Path(path)
+        candidates = sorted(path.rglob("*.py")) if path.is_dir() else [path]
+        for pyfile in candidates:
+            files += 1
+            source = pyfile.read_text()
+            tree = ast.parse(source, filename=str(pyfile))
+            linter = _FnLinter(str(pyfile), source.splitlines())
+            # the module body drives the walk; nested/class functions
+            # are recursed into with fresh scopes as encountered.
+            linter.walk_block(tree.body, {})
+            report.violations.extend(linter.violations)
+    report.ok = not report.violations
+    report.notes = f"linted {files} file(s)"
+    return report

@@ -1,0 +1,57 @@
+"""Structured audit reports and their text rendering."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Sequence
+
+from .rules import RuleReport
+from .walker import WalkStats
+
+
+@dataclass
+class AuditReport:
+    """The result of auditing one traced target against a rule set."""
+
+    target: str
+    rule_reports: list = field(default_factory=list)
+    stats: WalkStats = field(default_factory=WalkStats)
+
+    @property
+    def ok(self) -> bool:
+        return all(r.ok for r in self.rule_reports)
+
+    @property
+    def violations(self) -> list:
+        return [v for r in self.rule_reports for v in r.violations]
+
+    def rule(self, name: str) -> RuleReport:
+        for r in self.rule_reports:
+            if r.rule == name:
+                return r
+        raise KeyError(name)
+
+    def format(self, verbose: bool = False) -> str:
+        head = "PASS" if self.ok else "FAIL"
+        lines = [f"=== audit: {self.target} [{head}] "
+                 f"({self.stats.eqn_count} eqns, depth {self.stats.max_depth}, "
+                 f"descended: {', '.join(sorted(self.stats.descended_into)) or '-'})"]
+        for r in self.rule_reports:
+            mark = "ok " if r.ok else "FAIL"
+            lines.append(f"  [{mark}] {r.rule:<10} "
+                         f"({r.checked_eqns} checked"
+                         f"{', ' + r.notes if (verbose and r.notes) else ''})")
+            for v in r.violations:
+                lines.append(f"         - {v}")
+        return "\n".join(lines)
+
+
+def format_reports(reports: Sequence[AuditReport],
+                   verbose: bool = False) -> str:
+    body = "\n".join(r.format(verbose=verbose) for r in reports)
+    bad = sum(not r.ok for r in reports)
+    total_v = sum(len(r.violations) for r in reports)
+    tail = (f"\n{len(reports)} audit(s): "
+            + (f"{bad} FAILED, {total_v} violation(s)" if bad
+               else "all passed"))
+    return body + tail

@@ -1,0 +1,80 @@
+"""Retrace guard: prove a jitted function does not recompile after warmup.
+
+Retraces are the silent killer of the federated round's throughput: a
+python scalar where a weak-typed array should be, or an ``int`` round
+index promoted differently between calls, and every "round" quietly pays
+a multi-second XLA compile.  The guard runs the function once to warm
+the cache, records the compile-cache size, then drives ``repeats``
+further calls through inputs produced by ``make_args(i)`` and asserts
+the cache size never grows.
+
+Uses ``jitted._cache_size()`` (public enough that jax's own test suite
+relies on it); when absent — e.g. the target is a plain function — the
+guard falls back to ``jax.monitoring`` -free compile counting via a
+fresh ``jax.jit`` wrapper.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+
+from .rules import RuleReport, Violation
+
+
+def _cache_size(jitted) -> Optional[int]:
+    fn = getattr(jitted, "_cache_size", None)
+    if callable(fn):
+        return fn()
+    return None
+
+
+def check_retrace(jitted: Callable,
+                  make_args: Optional[Callable[[int], tuple]],
+                  repeats: int = 3, warmup: int = 1,
+                  drive: Optional[Callable[[int], None]] = None) -> RuleReport:
+    """Run ``warmup`` + ``repeats`` calls; fail if the compile cache grew
+    after warmup.
+
+    ``make_args(i)`` returns the positional args for call ``i`` (0-based
+    across warmup + measured calls).  Vary the *values* between calls —
+    a retrace bug by definition only shows up when something about the
+    inputs changes.
+
+    Alternatively pass ``drive(i)``, a callable performing one full call
+    through whatever wrapper the production path uses (e.g.
+    ``FedLearner.train_round_async``, which owns donated state and rng
+    chains); ``jitted`` is then only inspected for its cache size.
+    """
+    report = RuleReport(rule="retrace", ok=True)
+    if drive is None and _cache_size(jitted) is None:
+        jitted = jax.jit(jitted)
+    if drive is None:
+        def drive(i, _j=jitted, _m=make_args):
+            jax.block_until_ready(_j(*_m(i)))
+    elif _cache_size(jitted) is None:
+        raise ValueError("drive-mode retrace check needs a jitted fn "
+                         "exposing _cache_size")
+
+    call = 0
+    for _ in range(warmup):
+        drive(call)
+        call += 1
+    baseline = _cache_size(jitted)
+
+    for i in range(repeats):
+        drive(call)
+        call += 1
+        size = _cache_size(jitted)
+        if size > baseline:
+            report.ok = False
+            report.violations.append(Violation(
+                rule="retrace", path="", primitive="jit",
+                message=f"compile cache grew {baseline} -> {size} on "
+                        f"post-warmup call {i + 1}/{repeats}"))
+            baseline = size  # report each further growth once
+    report.checked_eqns = call
+    report.notes = (f"{warmup} warmup + {repeats} measured calls; "
+                    f"final cache size {_cache_size(jitted)}")
+    return report

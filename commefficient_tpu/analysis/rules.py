@@ -1,0 +1,275 @@
+"""Static rules applied to a walked jaxpr, and the report they produce.
+
+A rule is a small object with a ``name`` and a ``check(sites, stats,
+dims)`` method returning a :class:`RuleReport`.  Rules see *every*
+equation of the traced program — including those inside ``custom_vjp``,
+``remat`` and ``scan`` sub-jaxprs, via :mod:`..analysis.walker` — so a
+passing footprint audit is a statement about the whole computation, not
+just its top level.
+
+The three jaxpr-level rules here are static; the retrace guard
+(:mod:`.retrace`) and PRNG lint (:mod:`.prng_lint`) have their own
+modules because they are not jaxpr walks (one counts compile-cache
+entries across live calls, the other reads source ASTs).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence, Union
+
+import numpy as np
+
+from .walker import EqnSite, WalkStats
+
+DimName = Union[str, int]
+
+
+@dataclass(frozen=True)
+class Violation:
+    rule: str
+    message: str
+    path: str            # enclosing-primitive path ("" = top level)
+    primitive: str
+    shape: Optional[tuple] = None
+    dtype: Optional[str] = None
+
+    def __str__(self) -> str:
+        where = self.path or "<top>"
+        return f"[{self.rule}] {where} :: {self.primitive}: {self.message}"
+
+
+@dataclass
+class RuleReport:
+    rule: str
+    ok: bool
+    violations: list = field(default_factory=list)
+    checked_eqns: int = 0
+    notes: str = ""
+
+
+# --------------------------------------------------------------------------
+# footprint
+# --------------------------------------------------------------------------
+
+#: Primitives that may legitimately *output* a forbidden-shaped array:
+#: scatter-family eqns are how per-client state rows are written back
+#: (``state.clients.errors.at[ids].set(rows)`` -> full ``(num_clients,
+#: d)`` output), which is carried state, not a materialized intermediate.
+SCATTER_PRIMITIVES = frozenset({
+    "scatter", "scatter-add", "scatter-mul", "scatter-min", "scatter-max",
+    "dynamic_update_slice",
+})
+
+
+@dataclass(frozen=True)
+class ShapePattern:
+    """A symbolic forbidden shape, e.g. ``("W", "d")`` or ``("B", "H",
+    "T", "T")``.  Dim names bind against the ``dims`` mapping passed to
+    the audit; ints match literally.  2-D patterns also match their
+    transpose (the original walker forbade both ``(W, d)`` and
+    ``(d, W)``)."""
+
+    dims: tuple
+    label: str = ""
+    #: eqns whose *outputs* may carry this shape (state writeback).
+    allow_primitives: frozenset = frozenset()
+    #: both orientations for rank-2 patterns (default True).
+    match_transpose: bool = True
+
+    def concretize(self, bindings: dict) -> list:
+        shape = []
+        for dim in self.dims:
+            if isinstance(dim, int):
+                shape.append(dim)
+            elif dim in bindings:
+                shape.append(int(bindings[dim]))
+            else:
+                return []  # unbound symbol: pattern inactive for this audit
+        shapes = [tuple(shape)]
+        if self.match_transpose and len(shape) == 2 and shape[0] != shape[1]:
+            shapes.append((shape[1], shape[0]))
+        return shapes
+
+    def describe(self) -> str:
+        sym = "(" + ", ".join(str(d) for d in self.dims) + ")"
+        return f"{self.label or 'forbidden'} {sym}"
+
+
+#: The repo's standing memory contracts (docs/ROOFLINE.md, PR 2/3):
+#: no dense per-client matrix, no dense staleness-window changed-matrix,
+#: no materialized attention-score volume.
+DEFAULT_PATTERNS = (
+    ShapePattern(("num_clients", "d"), label="dense client matrix",
+                 allow_primitives=SCATTER_PRIMITIVES),
+    ShapePattern(("W", "d"), label="dense changed-matrix"),
+    ShapePattern(("B", "H", "T", "T"), label="materialized attention scores",
+                 match_transpose=False),
+)
+
+
+class FootprintRule:
+    """Flag intermediates matching forbidden symbolic shapes or whose
+    output exceeds a per-eqn byte budget."""
+
+    name = "footprint"
+
+    def __init__(self, patterns: Sequence[ShapePattern] = DEFAULT_PATTERNS,
+                 max_eqn_bytes: Optional[int] = None):
+        self.patterns = tuple(patterns)
+        self.max_eqn_bytes = max_eqn_bytes
+
+    def check(self, sites: Sequence[EqnSite], stats: WalkStats,
+              dims: dict) -> RuleReport:
+        report = RuleReport(rule=self.name, ok=True)
+        active = []
+        for pat in self.patterns:
+            shapes = pat.concretize(dims)
+            if shapes:
+                active.append((pat, set(shapes)))
+        report.notes = "; ".join(
+            f"{p.describe()} -> {sorted(s)}" for p, s in active) or \
+            "no patterns bound for given dims"
+
+        for site in sites:
+            report.checked_eqns += 1
+            for var in site.eqn.outvars:
+                aval = getattr(var, "aval", None)
+                if aval is None or not hasattr(aval, "shape"):
+                    continue
+                shape = tuple(aval.shape)
+                for pat, shapes in active:
+                    if shape in shapes and \
+                            site.primitive not in pat.allow_primitives:
+                        report.ok = False
+                        report.violations.append(Violation(
+                            rule=self.name, path=site.path,
+                            primitive=site.primitive, shape=shape,
+                            dtype=str(getattr(aval, "dtype", "?")),
+                            message=f"{pat.describe()} materialized as "
+                                    f"{shape}"))
+                if self.max_eqn_bytes is not None:
+                    nbytes = int(np.prod(shape, dtype=np.int64)) * \
+                        np.dtype(aval.dtype).itemsize
+                    if nbytes > self.max_eqn_bytes:
+                        report.ok = False
+                        report.violations.append(Violation(
+                            rule=self.name, path=site.path,
+                            primitive=site.primitive, shape=shape,
+                            dtype=str(aval.dtype),
+                            message=f"eqn output {nbytes} B exceeds "
+                                    f"budget {self.max_eqn_bytes} B"))
+        return report
+
+
+# --------------------------------------------------------------------------
+# transfer
+# --------------------------------------------------------------------------
+
+#: Primitives that move control or data across the device/host boundary
+#: from *inside* a jitted computation.  Any of these inside the round
+#: serializes the TPU against the Python host and breaks the async
+#: offload pipeline's overlap guarantees.
+HOST_BOUNDARY_PRIMITIVES = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "outside_call",
+    "infeed", "outfeed", "host_callback_call",
+})
+
+
+class TransferRule:
+    """No host callbacks / implicit transfers inside the jitted region.
+
+    Static half of the transfer contract; the dynamic half is
+    ``jax.transfer_guard("disallow")`` scoped around the round dispatch
+    (see ``federated/api.py``) so implicit h2d/d2h at *call* time also
+    raises.
+    """
+
+    name = "transfer"
+
+    def __init__(self, forbidden=HOST_BOUNDARY_PRIMITIVES,
+                 allow_debug_prints: bool = False):
+        self.forbidden = frozenset(forbidden)
+        if allow_debug_prints:
+            self.forbidden = self.forbidden - {"debug_callback"}
+
+    def check(self, sites: Sequence[EqnSite], stats: WalkStats,
+              dims: dict) -> RuleReport:
+        report = RuleReport(rule=self.name, ok=True,
+                            notes=f"forbidden: {sorted(self.forbidden)}")
+        for site in sites:
+            report.checked_eqns += 1
+            if site.primitive in self.forbidden:
+                report.ok = False
+                report.violations.append(Violation(
+                    rule=self.name, path=site.path,
+                    primitive=site.primitive,
+                    message="host-boundary primitive inside jitted region"))
+        return report
+
+
+# --------------------------------------------------------------------------
+# dtype policy
+# --------------------------------------------------------------------------
+
+#: f32 is *expected* at these eqns even in a bf16 region: matmul
+#: accumulation, softmax internals, norms/stats reductions, and the
+#: cast eqns themselves.
+DTYPE_ALLOW_PRIMITIVES = frozenset({
+    "dot_general", "conv_general_dilated",          # accumulators
+    "reduce_sum", "reduce_max", "reduce_min", "cumsum", "cumlogsumexp",
+    "exp", "log", "logistic", "erf", "tanh", "rsqrt", "sqrt",  # softmax/gelu/norm
+    "div", "sub", "add", "mul", "max", "integer_pow",  # norm/softmax arithmetic
+    "convert_element_type", "stop_gradient", "select_n",
+    "broadcast_in_dim", "reshape", "transpose", "squeeze",
+    "reduce_precision", "custom_jvp_call", "pjit",
+})
+
+
+class DtypeRule:
+    """Flag *large* f32 intermediates inside a declared-bf16 region.
+
+    Within a model compiled with ``dtype=bfloat16`` the activation
+    stream should stay bf16; f32 is allowed where numerics demand it
+    (accumulators, softmax, norm statistics — the primitive allowlist)
+    and for small tensors (params stats, scalars).  Anything else is a
+    silent 2x memory-bandwidth regression.
+
+    Only meaningful when the audited fn *declares* bf16 — audits of f32
+    programs should omit this rule (``analysis.audit`` does so unless
+    ``dims`` carries ``bf16=True``).
+    """
+
+    name = "dtype"
+
+    def __init__(self, min_elements: int = 1 << 16,
+                 allow_primitives=DTYPE_ALLOW_PRIMITIVES):
+        self.min_elements = min_elements
+        self.allow_primitives = frozenset(allow_primitives)
+
+    def check(self, sites: Sequence[EqnSite], stats: WalkStats,
+              dims: dict) -> RuleReport:
+        report = RuleReport(
+            rule=self.name, ok=True,
+            notes=f"flagging f32 outputs > {self.min_elements} elements "
+                  f"outside accumulator/softmax allowlist")
+        for site in sites:
+            report.checked_eqns += 1
+            if site.primitive in self.allow_primitives:
+                continue
+            for var in site.eqn.outvars:
+                aval = getattr(var, "aval", None)
+                if aval is None or not hasattr(aval, "shape"):
+                    continue
+                if str(getattr(aval, "dtype", "")) != "float32":
+                    continue
+                n = int(np.prod(tuple(aval.shape), dtype=np.int64))
+                if n > self.min_elements:
+                    report.ok = False
+                    report.violations.append(Violation(
+                        rule=self.name, path=site.path,
+                        primitive=site.primitive,
+                        shape=tuple(aval.shape), dtype="float32",
+                        message=f"f32 intermediate of {n} elements in "
+                                f"bf16 region"))
+        return report

@@ -1,0 +1,301 @@
+"""The repo's auditable programs, built at CPU-friendly scale.
+
+Each target constructs the real production code path — the federated
+round via ``FedLearner``/``build_round_step``, the GPT2 train step with
+``remat=True``, the flash-attention custom VJP, the CountSketch ops —
+at toy dimensions chosen so the forbidden shapes are distinctive (no
+accidental collisions with legitimate intermediates), traces it to a
+jaxpr, and binds the symbolic footprint dims.  The CLI and the tier-1
+``audit``-marked tests both run these.
+
+Dims are deliberately small: tracing is shape-polymorphic in spirit —
+a (W, d) changed-matrix materializes at W=3, d=46 exactly as it would
+at gpt2-small scale, and the audit is about *structure*, not size.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .report import AuditReport
+from .retrace import check_retrace
+from .rules import (DEFAULT_PATTERNS, FootprintRule, RuleReport, ShapePattern,
+                    TransferRule)
+from .walker import walk
+
+
+@dataclass
+class AuditTarget:
+    name: str
+    description: str
+    trace: Callable[[], object]          # () -> ClosedJaxpr
+    dims: dict = field(default_factory=dict)
+    rules: tuple = ()
+    retrace: Optional[Callable[[], RuleReport]] = None
+
+    def audit(self, with_retrace: bool = True) -> AuditReport:
+        closed = self.trace()
+        sites, stats = walk(closed)
+        report = AuditReport(target=self.name, stats=stats)
+        for rule in self.rules:
+            report.rule_reports.append(rule.check(sites, stats, self.dims))
+        if with_retrace and self.retrace is not None:
+            report.rule_reports.append(self.retrace())
+        return report
+
+
+# --------------------------------------------------------------------------
+# federated round
+# --------------------------------------------------------------------------
+
+ROUND_CFGS = {
+    "sketch": dict(mode="sketch", error_type="virtual",
+                   virtual_momentum=0.9, k=3, num_rows=3, num_cols=20),
+    "local_topk": dict(mode="local_topk", error_type="local",
+                       local_momentum=0.9, k=3),
+    "uncompressed": dict(mode="uncompressed", error_type="none",
+                         virtual_momentum=0.0, local_momentum=0),
+}
+
+#: Modes that run the fused fold-the-batch path, where NO legitimate
+#: (W, d) stack exists and any such aval is the O(W·d) accounting
+#: changed-matrix leaking back (the PR 2 contract).  local_topk, by
+#: contrast, *owns* per-sampled-client (W, d) rows — local momentum and
+#: error feedback are per-client state — so only the (num_clients, d)
+#: ban binds there.
+FUSED_ROUND_MODES = ("sketch", "uncompressed")
+
+
+def _make_learner(num_workers=3, num_clients=7, **cfg_kw):
+    from commefficient_tpu.config import FedConfig
+    from commefficient_tpu.federated.api import FedLearner
+    from commefficient_tpu.federated.losses import make_cv_loss
+    from commefficient_tpu.models import TinyMLP
+
+    model = TinyMLP(num_classes=2, hidden=4)
+    cfg = FedConfig(weight_decay=0, num_workers=num_workers,
+                    num_clients=num_clients, lr_scale=0.05, **cfg_kw)
+    return FedLearner(model, cfg, make_cv_loss(model), None,
+                      jax.random.PRNGKey(1), np.zeros((1, 8), np.float32))
+
+
+def _round_batch(w=3, rng=None):
+    rng = rng or np.random.RandomState(0)
+    Xb = jnp.asarray(rng.randn(w, 4, 8).astype(np.float32))
+    yb = jnp.asarray(rng.randint(0, 2, (w, 4)).astype(np.int32))
+    return (Xb, yb), jnp.ones((w, 4), jnp.float32)
+
+
+def round_target(mode: str = "sketch") -> AuditTarget:
+    w, n_clients = 3, 7
+    ln = _make_learner(num_workers=w, num_clients=n_clients,
+                       **ROUND_CFGS[mode])
+    d = int(ln.state.last_changed.shape[0])
+    batch, mask = _round_batch(w)
+    ids = jnp.arange(w, dtype=jnp.int32)
+
+    def trace():
+        return jax.make_jaxpr(ln._round.raw)(
+            ln.state, ids, batch, mask, jnp.float32(0.05),
+            jax.random.PRNGKey(0))
+
+    def retrace():
+        rng = np.random.RandomState(3)
+
+        def drive(i):
+            ids_i = rng.choice(n_clients, w, replace=False)
+            b, m = _round_batch(w, rng)
+            ln.train_round_async(ids_i, b, m)
+
+        return check_retrace(ln._round, None, repeats=3, warmup=1,
+                             drive=drive)
+
+    dims = {"num_clients": n_clients, "d": d}
+    if mode in FUSED_ROUND_MODES:
+        dims["W"] = w
+    return AuditTarget(
+        name=f"round/{mode}",
+        description=f"federated round, mode={mode} (TinyMLP scale)",
+        trace=trace,
+        dims=dims,
+        rules=(FootprintRule(DEFAULT_PATTERNS), TransferRule()),
+        retrace=retrace)
+
+
+# --------------------------------------------------------------------------
+# GPT2 train step (remat=True)
+# --------------------------------------------------------------------------
+
+def gpt2_target() -> AuditTarget:
+    from commefficient_tpu.federated.losses import make_gpt2_train_loss
+    from commefficient_tpu.models.gpt2 import GPT2Config, GPT2DoubleHeads
+
+    B, C, T, V = 3, 2, 16, 300
+    cfg = GPT2Config.tiny(vocab_size=V)
+    cfg.remat = True
+    cfg.dropout = 0.1
+    # the audited contract is the production attention path: blockwise
+    # keeps scores in (block, block) tiles, never a full (B*C, H, T, T)
+    cfg.attn_impl = "blockwise"
+    cfg.attn_block_size = 8
+    model = GPT2DoubleHeads(cfg)
+    rng = np.random.RandomState(5)
+    ids = jnp.asarray(rng.randint(0, V, (B, C, T)).astype(np.int32))
+    types = jnp.asarray(rng.randint(0, 3, (B, C, T)).astype(np.int32))
+    mc = jnp.full((B, C), T - 1, jnp.int32)
+    labels = jnp.asarray(np.where(rng.rand(B, C, T) < 0.5,
+                                  np.asarray(ids), -1).astype(np.int32))
+    mcl = jnp.ones((B,), jnp.int32)
+    batch = (ids, mc, labels, mcl, types)
+    params = model.init(jax.random.PRNGKey(0), ids, types, mc,
+                        train=False)["params"]
+    apply_loss = make_gpt2_train_loss(model)
+
+    def step(p, bt, key):
+        def total(q):
+            loss, _ = apply_loss(q, bt, key, True)
+            return jnp.sum(loss)
+
+        grads = jax.grad(total)(p)
+        return jax.tree.map(lambda x, g: x - 0.1 * g, p, grads)
+
+    def trace():
+        return jax.make_jaxpr(step)(params, batch, jax.random.PRNGKey(1))
+
+    def retrace():
+        jitted = jax.jit(step)
+        rs = np.random.RandomState(11)
+
+        def make_args(i):
+            ids_i = jnp.asarray(rs.randint(0, V, (B, C, T)).astype(np.int32))
+            bt = (ids_i, mc, labels, mcl, types)
+            return (params, bt, jax.random.PRNGKey(i))
+
+        return check_retrace(jitted, make_args, repeats=3, warmup=1)
+
+    return AuditTarget(
+        name="gpt2/train-step",
+        description="GPT2 tiny train step, remat=True, blockwise attention",
+        trace=trace,
+        # attention folds choices into the batch: scores would be
+        # (B*C, H, T, T) if materialized
+        dims={"B": B * C, "H": cfg.n_head, "T": T},
+        rules=(FootprintRule(DEFAULT_PATTERNS), TransferRule()),
+        retrace=retrace)
+
+
+# --------------------------------------------------------------------------
+# flash attention custom VJP
+# --------------------------------------------------------------------------
+
+def attention_target(bwd: bool = True) -> AuditTarget:
+    from commefficient_tpu.ops.flash_attention import flash_attention
+
+    B, T, H, D = 2, 64, 2, 8
+    rng = np.random.RandomState(7)
+    q, k, v = (jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+               for _ in range(3))
+
+    def fwd(q, k, v):
+        return flash_attention(q, k, v, causal=True, block_q=32, block_k=32,
+                               interpret=True)
+
+    if bwd:
+        fn = jax.grad(lambda q, k, v: jnp.sum(fwd(q, k, v)),
+                      argnums=(0, 1, 2))
+        name = "attention/flash-bwd"
+        desc = "flash attention backward (custom-VJP bwd, inlined by grad)"
+    else:
+        fn = fwd
+        name = "attention/flash-fwd"
+        desc = "flash attention forward (custom_vjp_call_jaxpr descent)"
+
+    def trace():
+        return jax.make_jaxpr(fn)(q, k, v)
+
+    def retrace():
+        jitted = jax.jit(fn)
+        rs = np.random.RandomState(13)
+
+        def make_args(i):
+            return tuple(jnp.asarray(rs.randn(B, T, H, D).astype(np.float32))
+                         for _ in range(3))
+
+        return check_retrace(jitted, make_args, repeats=3, warmup=1)
+
+    return AuditTarget(
+        name=name, description=desc, trace=trace,
+        dims={"B": B, "H": H, "T": T},
+        rules=(FootprintRule(DEFAULT_PATTERNS), TransferRule()),
+        # interpret-mode pallas compiles per call on CPU are still
+        # cached by jit; the retrace check holds
+        retrace=retrace)
+
+
+# --------------------------------------------------------------------------
+# sketch ops
+# --------------------------------------------------------------------------
+
+def sketch_target() -> AuditTarget:
+    from commefficient_tpu.ops.countsketch import CountSketch
+
+    d, c, r, k = 1000, 128, 3, 10
+    cs = CountSketch(d=d, c=c, r=r, seed=7)
+    rng = np.random.RandomState(9)
+    vec = jnp.asarray(rng.randn(d).astype(np.float32))
+
+    def roundtrip(v):
+        table = cs.sketch_vec(v)
+        return cs.unsketch(table, k)
+
+    def trace():
+        return jax.make_jaxpr(roundtrip)(vec)
+
+    def retrace():
+        jitted = jax.jit(roundtrip)
+
+        def make_args(i):
+            return (jnp.asarray(rng.randn(d).astype(np.float32)),)
+
+        return check_retrace(jitted, make_args, repeats=3, warmup=1)
+
+    return AuditTarget(
+        name="sketch/roundtrip",
+        description="CountSketch sketch_vec + unsketch top-k",
+        trace=trace,
+        dims={},
+        # no symbolic patterns bind here; the contract is the byte
+        # budget: nothing in the sketch pipeline may materialize more
+        # than a handful of d-length temporaries (the one-hot scatter
+        # path would blow this budget at (d, c) scale)
+        rules=(FootprintRule(DEFAULT_PATTERNS,
+                             max_eqn_bytes=64 * d * 4),
+               TransferRule()),
+        retrace=retrace)
+
+
+# --------------------------------------------------------------------------
+# registry
+# --------------------------------------------------------------------------
+
+def build_targets(name: str) -> list:
+    """Targets for a CLI/gate name: round|gpt2|attention|sketch|all."""
+    if name == "round":
+        return [round_target("sketch"), round_target("local_topk"),
+                round_target("uncompressed")]
+    if name == "gpt2":
+        return [gpt2_target()]
+    if name == "attention":
+        return [attention_target(bwd=False), attention_target(bwd=True)]
+    if name == "sketch":
+        return [sketch_target()]
+    if name == "all":
+        return (build_targets("round") + build_targets("gpt2")
+                + build_targets("attention") + build_targets("sketch"))
+    raise ValueError(f"unknown audit target {name!r} "
+                     f"(round|gpt2|attention|sketch|all)")

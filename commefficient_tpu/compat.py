@@ -1,0 +1,17 @@
+"""Version shims for the narrow band of jax APIs whose spelling moved.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to the top
+level in jax 0.6, renaming the replication-check kwarg ``check_rep`` to
+``check_vma`` along the way. Everything here targets the new spelling;
+on older jax the wrapper translates.
+"""
+
+try:
+    from jax import shard_map  # noqa: F401  (jax>=0.6)
+except ImportError:
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    def shard_map(f, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _experimental_shard_map(f, **kwargs)

@@ -33,6 +33,42 @@ from commefficient_tpu.federated.state import (CLIENT_STATE_FIELDS,
 from commefficient_tpu.utils.params import flatten_params
 from commefficient_tpu.utils.schedules import PiecewiseLinear
 
+# --------------------------------------------------------------------------
+# Transfer guard around the round dispatch.
+#
+# The jitted round must never trigger an implicit host<->device transfer
+# at call time: a python scalar or numpy array slipping into the dispatch
+# serializes the async pipeline (and usually means a retrace is next).
+# All conversions (jnp.asarray / device_put / the lr scalar) happen
+# BEFORE the guarded region, so under "disallow" the dispatch itself is
+# proven transfer-free.  conftest.py turns this on for the whole test
+# suite; training entrypoints expose it as --transfer_guard (default
+# disallow).  A module switch rather than a global jax.transfer_guard
+# because a process-wide "disallow" would (correctly) reject ordinary
+# host-side setup like jnp.zeros or device_get.
+# --------------------------------------------------------------------------
+
+_TRANSFER_GUARD_MODE = "allow"
+
+
+def set_transfer_guard(mode: str) -> None:
+    """Set the guard mode ('allow' | 'log' | 'disallow') applied around
+    every jitted round dispatch (train_round_async / train_rounds_scan /
+    evaluate)."""
+    if mode not in ("allow", "log", "disallow"):
+        raise ValueError(f"transfer_guard must be allow|log|disallow, "
+                         f"got {mode!r}")
+    global _TRANSFER_GUARD_MODE
+    _TRANSFER_GUARD_MODE = mode
+
+
+def transfer_guard_mode() -> str:
+    return _TRANSFER_GUARD_MODE
+
+
+def _dispatch_guard():
+    return jax.transfer_guard(_TRANSFER_GUARD_MODE)
+
 
 class FedLearner:
     def __init__(self, module, cfg: FedConfig, loss_train: Callable,
@@ -197,6 +233,16 @@ class FedLearner:
     def lr_at(self, t: float) -> float:
         return float(self.lr_schedule(t))
 
+    def _replicate(self, *xs):
+        """Explicitly replicate per-call args (lr scalar, round rng, eval
+        batch) across the mesh. Under the dispatch transfer guard the jit
+        may not implicitly broadcast a single-device array to all mesh
+        devices — device_put is the sanctioned, explicit transfer."""
+        from jax.sharding import NamedSharding, PartitionSpec
+        repl = NamedSharding(self.mesh, PartitionSpec())
+        out = tuple(jax.device_put(x, repl) for x in xs)
+        return out if len(out) > 1 else out[0]
+
     def train_round_async(self, client_ids, batch, mask, epoch_frac=None,
                           next_client_ids=None):
         """Dispatch one federated round WITHOUT blocking on the result.
@@ -226,20 +272,28 @@ class FedLearner:
             ids = jax.device_put(ids, ids_sh)
             cols = jax.device_put(cols, cols_sh)
             m = jax.device_put(m, mask_sh)
-        lr_in = lr if self.lr_scale_vec is None else lr * self.lr_scale_vec
+        # device scalar, not a python float: the guarded dispatch below
+        # must not trigger an implicit h2d, and a weak-typed scalar is
+        # one dtype-promotion away from a retrace
+        lr_in = (jnp.float32(lr) if self.lr_scale_vec is None
+                 else lr * self.lr_scale_vec)
+        if self.mesh is not None:
+            lr_in, round_rng = self._replicate(lr_in, round_rng)
         if self._offload:
             ids_np = np.asarray(client_ids).astype(np.int64)
             valid = np.asarray(mask).any(axis=1)
             rows = self._offload_pipe.gather(ids_np)
-            self.state, out_rows, metrics = self._round(
-                self.state, rows, ids, cols, m, lr_in, round_rng)
+            with _dispatch_guard():
+                self.state, out_rows, metrics = self._round(
+                    self.state, rows, ids, cols, m, lr_in, round_rng)
             self._offload_pipe.push(ids_np, valid, out_rows)
             if next_client_ids is not None:
                 self._offload_pipe.prefetch(
                     np.asarray(next_client_ids).astype(np.int64))
         else:
-            self.state, metrics = self._round(self.state, ids, cols, m,
-                                              lr_in, round_rng)
+            with _dispatch_guard():
+                self.state, metrics = self._round(self.state, ids, cols, m,
+                                                  lr_in, round_rng)
         self.rounds_done += 1
         metrics["lr"] = lr
         return metrics
@@ -353,8 +407,11 @@ class FedLearner:
             ids = jax.device_put(ids, ids_sh)
             cols = jax.device_put(cols, cols_sh)
             m = jax.device_put(m, mask_sh)
-        self.state, metrics = self._rounds_scan_fn()(
-            self.state, ids, cols, m, lrs, rngs)
+            lrs, rngs = self._replicate(lrs, rngs)
+        scan_fn = self._rounds_scan_fn()
+        with _dispatch_guard():
+            self.state, metrics = scan_fn(self.state, ids, cols, m, lrs,
+                                          rngs)
         self.rounds_done += K
         metrics["lr"] = lrs_host   # host-known; keeps the dispatch async
         return metrics
@@ -408,10 +465,13 @@ class FedLearner:
         loss_sum, metric_sums, n_total = 0.0, None, 0.0
         for batch, mask in batches:
             self.rng, eval_rng = jax.random.split(self.rng)
-            out = jax.device_get(self._eval(
-                self.state.weights,
-                tuple(jnp.asarray(t) for t in batch),
-                jnp.asarray(mask, jnp.float32), eval_rng))
+            cols = tuple(jnp.asarray(t) for t in batch)
+            m = jnp.asarray(mask, jnp.float32)
+            if self.mesh is not None:
+                cols, m, eval_rng = self._replicate(cols, m, eval_rng)
+            with _dispatch_guard():
+                out_dev = self._eval(self.state.weights, cols, m, eval_rng)
+            out = jax.device_get(out_dev)
             loss_sum += float(out["loss_sum"])
             ms = np.asarray(out["metric_sums"])
             metric_sums = ms if metric_sums is None else metric_sums + ms

@@ -260,8 +260,9 @@ def ring_attention_sharded(mesh, q, k, v, *, axis_name: str = "seq",
                            kv_mask: Optional[jax.Array] = None) -> jax.Array:
     """Convenience wrapper: shard q/k/v over ``axis_name`` and run
     ``ring_attention``. Inputs/outputs are global (B, T, H, D) arrays."""
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from commefficient_tpu.compat import shard_map
 
     qkv_spec = P(None, axis_name, None, None)
     mask_spec = P(None, axis_name)

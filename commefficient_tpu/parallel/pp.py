@@ -41,8 +41,9 @@ from functools import lru_cache, partial
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
+
+from commefficient_tpu.compat import shard_map
 
 from commefficient_tpu.models.gpt2 import Block, GPT2Config
 
@@ -173,8 +174,17 @@ def _build_pipe(mesh, axis_name, block_key, S, per_stage, B, T, n_micro,
 
     data_spec = P(dp_axis) if dp_axis else P()
 
+    # The staged (S, per_stage, ...) tree enters REPLICATED and each
+    # stage dynamic-slices its own layer group inside the body, instead
+    # of an in_spec of P(axis_name): the stack+reshape that builds it is
+    # traced in the same jit, and on jax<0.5 a concatenated value that
+    # resharding must split ALONG the concatenated axis (while
+    # replicating over the other mesh axis) is mis-lowered as a partial
+    # sum — each device's copy gets added and the trunk weights arrive
+    # multiplied by the dp-axis size. Replication sidesteps the bad
+    # reshard; params are replicated everywhere in this design anyway.
     @partial(shard_map, mesh=mesh,
-             in_specs=(P(axis_name), data_spec, data_spec, P(), P()),
+             in_specs=(P(), data_spec, data_spec, P(), P()),
              out_specs=data_spec, check_vma=False)
     def pipe(stage_params, ids, types, pos_embed_inputs, base_key):
         my = jax.lax.axis_index(axis_name)
@@ -183,8 +193,12 @@ def _build_pipe(mesh, axis_name, block_key, S, per_stage, B, T, n_micro,
             # same fold parallel/seq._shard_rngs applies)
             base_key = jax.random.fold_in(
                 base_key, jax.lax.axis_index(dp_axis))
-        # local stage params: (1, per_stage, ...) -> (per_stage, ...)
-        local = jax.tree_util.tree_map(lambda leaf: leaf[0], stage_params)
+        # local stage params: (S, per_stage, ...) -> this stage's
+        # (per_stage, ...) group
+        local = jax.tree_util.tree_map(
+            lambda leaf: jax.lax.dynamic_index_in_dim(leaf, my, 0,
+                                                      keepdims=False),
+            stage_params)
 
         # every device embeds (cheap, replicated weights)
         wte, wpe = pos_embed_inputs

@@ -27,8 +27,9 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
+
+from commefficient_tpu.compat import shard_map
 
 
 def _shard_rngs(rngs, *axis_names):
@@ -83,6 +84,21 @@ def _shift_labels(lm_labels):
     shifted labels)."""
     from commefficient_tpu.federated.losses import shift_labels
     return shift_labels(lm_labels)
+
+
+def _shift_labels_halo(labs, axis_name: str):
+    """``losses.shift_labels`` applied INSIDE shard_map on a (.., T_loc)
+    sequence shard: shifted[t] = labels[t+1] at GLOBAL position, so each
+    shard's final column is the NEXT shard's first column (one-hop
+    ppermute halo) and the last shard pads -1 (ppermute leaves
+    non-receiving shards zero-filled, so the -1 is written explicitly)."""
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    head = labs[..., :1]
+    nxt = jax.lax.ppermute(head, axis_name,
+                           [(i, i - 1) for i in range(1, n)])
+    nxt = jnp.where(my == n - 1, jnp.full_like(nxt, -1), nxt)
+    return jnp.concatenate([labs[..., 1:], nxt], axis=-1)
 
 
 def make_gpt2_train_loss_seq(mesh, model, lm_coef: float = 1.0,
@@ -154,16 +170,25 @@ def make_gpt2_val_loss_seq(mesh, model, axis_name: str = "seq"):
 
     def apply_loss(params, batch, rng, train):
         input_ids, mc_token_ids, lm_labels, mc_labels, token_type_ids = batch
-        shifted = _shift_labels(lm_labels)
         data_spec = P(None, None, axis_name)
 
+        # The labels enter RAW and shift inside the shard_map (ppermute
+        # halo) instead of pre-shifting at global shape like the train
+        # loss: here the batch dim replicates over the dp axis, and on
+        # jax<0.5 a value COMPUTED in-trace that must replicate over an
+        # unused mesh axis on entry to shard_map is mis-lowered as a
+        # partial sum — each device's copy gets added, labels land out of
+        # vocab range, and the CE goes NaN. Raw jit inputs reshard
+        # correctly; the halo keeps the shift convention exact across
+        # shard boundaries.
         @partial(shard_map, mesh=mesh,
                  in_specs=(P(), data_spec, data_spec, data_spec, P(), P()),
                  out_specs=(P(), P()), check_vma=False)
-        def run(p, ids, types, slabs, mc_ids, mc_labs):
+        def run(p, ids, types, labs, mc_ids, mc_labs):
             import optax
             lm, mc = model.apply({"params": p}, ids, types, mc_ids,
                                  train=False)
+            slabs = _shift_labels_halo(labs, axis_name)
             valid = slabs != -1
             safe = jnp.where(valid, slabs, 0)
             nll = optax.softmax_cross_entropy_with_integer_labels(
@@ -176,7 +201,7 @@ def make_gpt2_val_loss_seq(mesh, model, axis_name: str = "seq"):
             return (nll_sum / jnp.maximum(tokens, 1.0),
                     jnp.stack([acc, nll_sum, tokens]))
 
-        return run(params, input_ids, token_type_ids, shifted,
+        return run(params, input_ids, token_type_ids, lm_labels,
                    mc_token_ids, mc_labels)
 
     return apply_loss

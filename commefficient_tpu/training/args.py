@@ -140,6 +140,13 @@ def build_parser(default_lr: float = 0.4) -> argparse.ArgumentParser:
                         "memory lever for long sequences (measured "
                         "slightly SLOWER than XLA's fused materialized "
                         "path at T=256, docs/ROOFLINE.md)")
+    p.add_argument("--transfer_guard", choices=("allow", "log", "disallow"),
+                   default="disallow",
+                   help="jax.transfer_guard mode applied around every "
+                        "jitted round dispatch (federated/api.py): "
+                        "'disallow' (default) makes any implicit "
+                        "host<->device transfer at dispatch time an "
+                        "error, proving the round stays async")
     # DP
     p.add_argument("--dp", action="store_true", dest="do_dp")
     p.add_argument("--dp_mode", choices=DP_MODES, default="worker")

@@ -95,6 +95,8 @@ def build_learner(args, sample_input, num_classes, channels, mesh=None):
 
 
 def train(args, mesh=None, max_rounds=None, log=True):
+    from commefficient_tpu.federated.api import set_transfer_guard
+    set_transfer_guard(getattr(args, "transfer_guard", "disallow"))
     if mesh is not None and mesh.shape.get("seq", 1) > 1:
         # CV models have no sequence dimension; a seq axis here would
         # silently replicate and waste chips (the dead-flag defect class,

@@ -62,6 +62,8 @@ def make_persona(args, tokenizer, train: bool):
 
 
 def train(args, mesh=None, max_rounds=None, log=True):
+    from commefficient_tpu.federated.api import set_transfer_guard
+    set_transfer_guard(getattr(args, "transfer_guard", "disallow"))
     tokenizer = get_tokenizer(args.model_checkpoint)
     train_set = make_persona(args, tokenizer, train=True)
     val_set = make_persona(args, tokenizer, train=False)

@@ -19,6 +19,22 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
 
+# Every federated round dispatched anywhere in the suite runs under
+# jax.transfer_guard("disallow") — an implicit host<->device transfer at
+# round-dispatch time (python scalar, stray numpy array) fails the test
+# that triggered it.  Scoped around the dispatch (federated/api.py), not
+# process-wide: a global disallow would reject ordinary host-side setup.
+from commefficient_tpu.federated import api as _fed_api  # noqa: E402
+
+_fed_api.set_transfer_guard("disallow")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "audit: jaxpr-level invariant audits (graft-audit gate); "
+        "runnable standalone via -m audit")
+
 
 def pytest_sessionstart(session):
     assert jax.devices()[0].platform == "cpu", (

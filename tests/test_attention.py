@@ -283,8 +283,9 @@ def test_shard_rngs_decorrelate_dropout_across_shards():
     # shard draws a DIFFERENT mask realization (same iid distribution).
     from functools import partial
 
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from commefficient_tpu.compat import shard_map
 
     from commefficient_tpu.parallel.mesh import make_mesh
     from commefficient_tpu.parallel.seq import _shard_rngs
@@ -312,8 +313,9 @@ def test_ring_mc_logits_replicated_across_seq_shards_under_dropout():
     # contribution, models/gpt2.py). A post-psum dropout silently diverged.
     from functools import partial
 
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from commefficient_tpu.compat import shard_map
 
     from commefficient_tpu.models.gpt2 import GPT2Config, GPT2DoubleHeads
     from commefficient_tpu.parallel.mesh import make_mesh

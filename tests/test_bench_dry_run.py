@@ -1,0 +1,85 @@
+"""bench.py --dry-run: every row builds its REAL setup (model, learner,
+device batch) and traces its jitted programs via jax.eval_shape, then
+returns before any compile or timing. Signature drift, shape bugs and
+config rot surface at trace time on CPU in tier-1 instead of zeroing the
+next on-chip capture session. The cheap rows run for real here; the
+gpt2-small rows share the same _dry_trace_round plumbing and are covered
+by the registry test plus the CLI row filter.
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+def _boom(*a, **k):
+    raise AssertionError("timed path reached under --dry-run")
+
+
+@pytest.fixture
+def dry(monkeypatch):
+    monkeypatch.setattr(bench, "DRY_RUN", True)
+    # any attempt to execute/time device code would go through these
+    monkeypatch.setattr(bench, "_sync", _boom)
+    monkeypatch.setattr(bench, "_time", _boom)
+
+
+def test_registry_covers_every_row():
+    """The single row registry both the timed path and --dry-run iterate:
+    a row cannot exist in one mode and be silently skipped by the
+    other."""
+    names = [n for n, _ in bench._bench_rows()]
+    assert len(names) == len(set(names)) == 10
+    for must in ("cifar10_resnet9_fed_rounds_per_sec",
+                 "gpt2_personachat_tokens_per_sec_chip_flash_attn",
+                 "flash_attn_t256_parity_dropout_kernel_ab",
+                 "gpt2_longcontext_4k_blockwise_tokens_per_sec_chip",
+                 "offload_gather_scatter_overlap"):
+        assert must in names
+
+
+def test_cifar_row_traces_round_scan_and_sketch_ops(dry):
+    rps, breakdown = bench.bench_cifar_sketch()
+    assert rps["dry_run"] == "ok"
+    assert rps["out_leaves"] > 0
+    assert breakdown == {}
+
+
+def test_flash_ab_row_traces_every_config(dry):
+    status, results = bench.bench_flash_dropout_kernel_ab()
+    assert status["dry_run"] == "ok"
+    # 4 block-size sweep entries + nodropout + xla_full, all traced
+    assert status["configs"] == 6
+    assert all(v != v for v in results.values())  # NaN placeholders only
+
+
+def test_offload_row_traces_the_offload_round_signature(dry):
+    out = bench.bench_offload_overlap()
+    assert out["dry_run"] == "ok"
+
+
+def test_cli_dry_run_filters_rows_and_exits_zero(monkeypatch, capsys):
+    monkeypatch.setattr(sys, "argv",
+                        ["bench.py", "--dry-run", "--rows", "t256_parity"])
+    with pytest.raises(SystemExit) as ex:
+        bench.main()
+    assert ex.value.code == 0
+    out = capsys.readouterr().out
+    assert "dry-run ok   flash_attn_t256_parity_dropout_kernel_ab" in out
+    assert "cifar10" not in out
+    assert bench.DRY_RUN is False  # restored for a later timed run
+
+
+def test_dry_run_reports_tracing_failures(monkeypatch, capsys):
+    def drifted():
+        raise ValueError("round signature drifted")
+
+    monkeypatch.setattr(bench, "bench_flash_dropout_kernel_ab", drifted)
+    failed = bench._dry_run_main(row_filter="t256_parity")
+    assert failed == 1
+    assert "dry-run FAIL" in capsys.readouterr().out

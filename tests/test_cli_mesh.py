@@ -37,6 +37,7 @@ def test_round_up_workers():
     assert args2.num_workers == 16  # already divisible: untouched
 
 
+@pytest.mark.slow
 def test_cv_cli_trains_on_mesh(tmp_path, capsys):
     # the verdict's literal done-criterion command (plus a tmp dataset dir):
     #   python -m commefficient_tpu.training.cv --test --mesh clients=8
@@ -49,6 +50,7 @@ def test_cv_cli_trains_on_mesh(tmp_path, capsys):
     assert "final:" in out and "aborted" not in out
 
 
+@pytest.mark.slow
 def test_cv_cli_mesh_state_is_sharded(tmp_path):
     # white-box: the CLI path must produce genuinely sharded client state
     from commefficient_tpu.training.args import build_parser, parse_mesh
@@ -68,6 +70,7 @@ def test_cv_cli_mesh_state_is_sharded(tmp_path):
     assert np.isfinite(row["train_loss"])
 
 
+@pytest.mark.slow
 def test_gpt2_cli_trains_on_mesh(tmp_path, capsys):
     from commefficient_tpu.training.gpt2 import main
     rc = main(["--test", "--mesh", "clients=8", "--model", "gpt2-tiny",
@@ -80,6 +83,7 @@ def test_gpt2_cli_trains_on_mesh(tmp_path, capsys):
     assert "final:" in out and "aborted" not in out
 
 
+@pytest.mark.slow
 def test_gpt2_seq_parallel_federated_round_matches_unsharded(tmp_path):
     # VERDICT r3 #4: --mesh clients=4,seq=2 must be REAL — a federated
     # round with the sequence sharded over the seq axis (ring attention
@@ -142,6 +146,7 @@ def test_gpt2_ring_requires_seq_mesh(tmp_path):
         train(args, mesh=None, log=False)
 
 
+@pytest.mark.slow
 def test_gpt2_cli_2d_model_axis_sketch_mode(tmp_path, capsys):
     # VERDICT r3 #5: the 2D clients x model capability must be reachable
     # from the CLI, in sketch mode (sketch tables per fed_state_shardings)
@@ -180,6 +185,7 @@ def test_parse_mesh_rejects_nonpositive():
         parse_mesh("clients=4,seq=0")
 
 
+@pytest.mark.slow
 def test_eval_before_start(tmp_path, capsys):
     # ref cv_train.py:91: a validation pass before any training round
     from commefficient_tpu.training.cv import main
@@ -191,6 +197,7 @@ def test_eval_before_start(tmp_path, capsys):
     assert "eval before start:" in out
 
 
+@pytest.mark.slow
 def test_eval_before_start_does_not_change_trajectory(tmp_path):
     # the flag is logging-only: the rng snapshot must keep training
     # identical with and without it
@@ -213,6 +220,7 @@ def test_eval_before_start_does_not_change_trajectory(tmp_path):
     np.testing.assert_array_equal(w_plain, w_eval)
 
 
+@pytest.mark.slow
 def test_gpt2_eval_before_start(tmp_path, capsys):
     from commefficient_tpu.training.gpt2 import main
     rc = main(["--test", "--eval_before_start",
@@ -222,6 +230,7 @@ def test_gpt2_eval_before_start(tmp_path, capsys):
     assert "eval before start: nll=" in capsys.readouterr().out
 
 
+@pytest.mark.slow
 def test_cv_cli_scan_rounds_on_mesh_matches_per_round(tmp_path):
     """--scan_rounds K on a mesh: same trajectory as per-round dispatch,
     with the stacked batches device_put onto the sharded layout
@@ -250,6 +259,7 @@ def test_cv_cli_scan_rounds_on_mesh_matches_per_round(tmp_path):
                                                    rel=1e-5)
 
 
+@pytest.mark.slow
 def test_gpt2_cli_scan_rounds_smoke(tmp_path, capsys):
     # --scan_rounds through the gpt2 entrypoint (ScanWindow path with the
     # gpt2 loop's abort bookkeeping), plus the xla_rbg dropout flag
@@ -272,6 +282,7 @@ def test_parse_mesh_stage_axis_grammar():
         parse_mesh("clients=2,stage=2,seq=2")
 
 
+@pytest.mark.slow
 def test_gpt2_pp_federated_round_matches_unsharded(tmp_path):
     # VERDICT r4 Weak #7: --mesh clients=2,stage=2 must be REAL — a
     # federated round whose client loss runs through the GPipe pipeline
@@ -340,6 +351,7 @@ def test_parse_mesh_expert_axis_grammar():
         parse_mesh("clients=2,expert=2,stage=2")
 
 
+@pytest.mark.slow
 def test_gpt2_ep_federated_round_matches_unsharded(tmp_path):
     # the last parallelism axis composed with the federated round: MoE
     # expert weights shard over an 'expert' mesh axis inside the fused

@@ -35,7 +35,7 @@ CHILD = textwrap.dedent("""
     import numpy as np
     import jax.numpy as jnp
     from jax.sharding import Mesh, PartitionSpec as P
-    from jax import shard_map
+    from commefficient_tpu.compat import shard_map
 
     mesh = Mesh(np.array(jax.devices()), ("clients",))
     assert len(jax.devices()) == 2  # one per process
