@@ -605,6 +605,107 @@ def bench_offload_overlap(n_rounds=8):
     }
 
 
+def bench_buffered_rounds(n_rounds=8):
+    """Buffered async server (federated/buffer.py) vs the sync round at
+    the same config — ResNet9 local_topk, the offload row's scale.
+
+    Two claims worth a number: (1) the fault-free lock-step path (fused
+    cohort+apply, bit-identical to sync by tests/test_buffered.py) costs
+    ~nothing over the sync round — same program shape, one dispatch;
+    (2) with a fault model the event loop adds only host-side
+    bookkeeping per cohort (heap + deposit dispatches), reported as the
+    delta over the lock-step time alongside the simulated-clock stats
+    the --straggler results grid is built on."""
+    import jax
+    import jax.numpy as jnp
+
+    from commefficient_tpu.config import FedConfig
+    from commefficient_tpu.federated.api import FedLearner
+    from commefficient_tpu.federated.buffer import (BufferedFedLearner,
+                                                    init_buffer)
+    from commefficient_tpu.federated.faults import FaultModel
+    from commefficient_tpu.federated.losses import make_cv_loss
+    from commefficient_tpu.models import ResNet9
+
+    W, B, N = 4, 16, 12
+    model = ResNet9(num_classes=10, dtype="bfloat16")
+    rng = np.random.RandomState(0)
+    images = jnp.asarray(rng.randn(W, B, 32, 32, 3).astype(np.float32))
+    targets = jnp.asarray(rng.randint(0, 10, (W, B)).astype(np.int32))
+    mask = jax.device_put(jnp.ones((W, B), jnp.float32))
+    batch = (jax.device_put(images), jax.device_put(targets))
+
+    def make_learner(server_mode, fault_model=None):
+        cfg = FedConfig(mode="local_topk", k=50_000, error_type="local",
+                        local_momentum=0.9, virtual_momentum=0,
+                        num_workers=W, num_clients=N, lr_scale=0.1,
+                        server_mode=server_mode,
+                        staleness_alpha=0.5 if fault_model else 0.0)
+        cls = (BufferedFedLearner if server_mode == "buffered"
+               else FedLearner)
+        kw = {"fault_model": fault_model} if fault_model else {}
+        return cls(model, cfg, make_cv_loss(model), None,
+                   jax.random.PRNGKey(0), np.asarray(images[0][:1]), **kw)
+
+    def ids_fn(r):
+        return (np.arange(W) + r * W) % N
+
+    if DRY_RUN:
+        ln = make_learner("buffered")
+        ids = jnp.asarray(ids_fn(0), jnp.int32)
+        lr, key = jnp.float32(0.1), jax.random.PRNGKey(0)
+        # the fused lock-step program (fault-free path)
+        out = jax.eval_shape(ln._lockstep, ln.state, ids, batch, mask,
+                             lr, key)
+        # the split cohort -> deposit -> apply chain (event-loop path),
+        # composed in one trace so every signature is exercised
+        M = ln.cfg.effective_buffer_m
+
+        def full(state, ids_, cols, m, lr_, rng_):
+            contrib, _ = ln._cohort.raw(state, ids_, cols, m, lr_, rng_)
+            buf = init_buffer(contrib, M, ln.cfg.num_clients)
+            buf = ln._deposit.raw(buf, contrib,
+                                  jnp.ones((W,), jnp.bool_))
+            return ln._apply.raw(state.replace(buffer=buf), lr_, rng_)
+
+        jax.eval_shape(full, ln.state, ids, batch, mask, lr, key)
+        return {"dry_run": "ok",
+                "out_leaves": len(jax.tree.leaves(out))}
+
+    def timed_rounds(ln):
+        ln.finalize_round_metrics(
+            ln.train_round_async(ids_fn(0), batch, mask))  # compile
+        ln.train_round_async(ids_fn(1), batch, mask)       # warm
+        t0 = time.perf_counter()
+        raw = None
+        for r in range(n_rounds):
+            raw = ln.train_round_async(ids_fn(2 + r), batch, mask)
+        ln.finalize_round_metrics(raw)
+        return (time.perf_counter() - t0) / n_rounds
+
+    sync_t = timed_rounds(make_learner("sync"))
+    lockstep_t = timed_rounds(make_learner("buffered"))
+
+    fm = FaultModel(1, N, straggler_frac=0.25, straggler_mult=5.0,
+                    dropout_prob=0.1, crash_prob=0.05)
+    ln_f = make_learner("buffered", fault_model=fm)
+    faulted_t = timed_rounds(ln_f)
+    ln_f.flush_faults()
+
+    return {
+        "round_sync_ms": round(sync_t * 1e3, 1),
+        "round_buffered_lockstep_ms": round(lockstep_t * 1e3, 1),
+        # host event loop + split cohort/deposit/apply dispatches
+        "cohort_buffered_faulted_ms": round(faulted_t * 1e3, 1),
+        "event_loop_overhead_ms": round((faulted_t - lockstep_t) * 1e3,
+                                        1),
+        "faulted_sim_time": round(ln_f.sim_time, 2),
+        "faulted_applies_per_cohort": round(
+            ln_f.applies_done / max(ln_f.cohorts_done, 1), 3),
+        **{f"faulted_{k}": v for k, v in ln_f.fault_stats.items()},
+    }
+
+
 #: lowercase substrings that mark an exception as a transient
 #: tunnel/remote-compile hiccup (the shared-chip failure modes that
 #: repeatedly zeroed whole bench artifacts — VERDICT r5 top item); shape
@@ -676,6 +777,8 @@ def _bench_rows():
          lambda: bench_longcontext_tokens()),
         ("offload_gather_scatter_overlap",
          lambda: bench_offload_overlap()),
+        ("buffered_fedbuff_round_overhead",
+         lambda: bench_buffered_rounds()),
     ]
 
 

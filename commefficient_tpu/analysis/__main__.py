@@ -23,8 +23,8 @@ def main(argv=None) -> int:
         description="jaxpr-level invariant auditor (footprint / transfer / "
                     "retrace / dtype / prng)")
     parser.add_argument("--target", default="all",
-                        choices=["round", "gpt2", "attention", "sketch",
-                                 "all"])
+                        choices=["round", "buffered", "gpt2", "attention",
+                                 "sketch", "all"])
     parser.add_argument("--no-retrace", action="store_true",
                         help="skip the (compile-heavy) retrace guards")
     parser.add_argument("--prng-lint", action="store_true",

@@ -128,6 +128,65 @@ def round_target(mode: str = "sketch") -> AuditTarget:
 
 
 # --------------------------------------------------------------------------
+# buffered asynchronous round (FedBuff-style server)
+# --------------------------------------------------------------------------
+
+def buffered_target() -> AuditTarget:
+    """The fused lock-step program of the buffered server: cohort +
+    staleness-weighted apply in ONE jit (the fault-free production path,
+    and the program whose bit-identity with the sync round tier-1
+    pins).  Built with quarantine ON and staleness_alpha != 0 so the
+    audit walks the richest dataflow: the per-contribution exclusion
+    masks and the (1+tau)^-alpha reweighting are both in the jaxpr.
+
+    Same memory contract as round/local_topk: per-sampled-client (W, d)
+    rows are owned state here, so only the (num_clients, d) ban binds.
+    """
+    from commefficient_tpu.config import FedConfig
+    from commefficient_tpu.federated.buffer import BufferedFedLearner
+    from commefficient_tpu.federated.losses import make_cv_loss
+    from commefficient_tpu.models import TinyMLP
+
+    w, n_clients = 3, 7
+    model = TinyMLP(num_classes=2, hidden=4)
+    cfg = FedConfig(weight_decay=0, num_workers=w, num_clients=n_clients,
+                    lr_scale=0.05, server_mode="buffered",
+                    staleness_alpha=0.5, client_quarantine=True,
+                    quarantine_rounds=3, **ROUND_CFGS["local_topk"])
+    ln = BufferedFedLearner(model, cfg, make_cv_loss(model), None,
+                            jax.random.PRNGKey(1),
+                            np.zeros((1, 8), np.float32))
+    d = int(ln.state.last_changed.shape[0])
+    batch, mask = _round_batch(w)
+    ids = jnp.arange(w, dtype=jnp.int32)
+
+    def trace():
+        return jax.make_jaxpr(ln._lockstep.raw)(
+            ln.state, ids, batch, mask, jnp.float32(0.05),
+            jax.random.PRNGKey(0))
+
+    def retrace():
+        rng = np.random.RandomState(3)
+
+        def drive(i):
+            ids_i = rng.choice(n_clients, w, replace=False)
+            b, m = _round_batch(w, rng)
+            ln.train_round_async(ids_i, b, m)
+
+        return check_retrace(ln._lockstep, None, repeats=3, warmup=1,
+                             drive=drive)
+
+    return AuditTarget(
+        name="buffered/lockstep",
+        description="buffered async round, fused cohort+apply "
+                    "(quarantine + staleness, TinyMLP scale)",
+        trace=trace,
+        dims={"num_clients": n_clients, "d": d},
+        rules=(FootprintRule(DEFAULT_PATTERNS), TransferRule()),
+        retrace=retrace)
+
+
+# --------------------------------------------------------------------------
 # GPT2 train step (remat=True)
 # --------------------------------------------------------------------------
 
@@ -294,8 +353,11 @@ def build_targets(name: str) -> list:
         return [attention_target(bwd=False), attention_target(bwd=True)]
     if name == "sketch":
         return [sketch_target()]
+    if name == "buffered":
+        return [buffered_target()]
     if name == "all":
-        return (build_targets("round") + build_targets("gpt2")
-                + build_targets("attention") + build_targets("sketch"))
+        return (build_targets("round") + build_targets("buffered")
+                + build_targets("gpt2") + build_targets("attention")
+                + build_targets("sketch"))
     raise ValueError(f"unknown audit target {name!r} "
-                     f"(round|gpt2|attention|sketch|all)")
+                     f"(round|buffered|gpt2|attention|sketch|all)")

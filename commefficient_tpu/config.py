@@ -16,6 +16,7 @@ from typing import Optional, Tuple
 MODES = ("sketch", "true_topk", "local_topk", "fedavg", "uncompressed")
 ERROR_TYPES = ("none", "local", "virtual")
 DP_MODES = ("worker", "server")
+SERVER_MODES = ("sync", "buffered")
 
 
 @dataclass(frozen=True)
@@ -92,6 +93,28 @@ class FedConfig:
     valid_batch_size: int = 8
     microbatch_size: int = -1
 
+    # server aggregation discipline. 'sync' = the reference's lock-step
+    # round (every sampled client reports before the server steps).
+    # 'buffered' = FedBuff-style buffered async aggregation (Nguyen et al.,
+    # AISTATS 2022): contributions accumulate in a buffer of buffer_m
+    # slots; the server applies once the buffer fills, scaling each
+    # contribution by 1/(1+tau)^staleness_alpha where tau is how many
+    # server versions elapsed since that client pulled weights. With
+    # buffer_m == num_workers, zero injected faults and alpha == 0 the
+    # trajectory is BIT-IDENTICAL to sync (tests/test_buffered.py).
+    server_mode: str = "sync"
+    buffer_m: int = 0          # 0 => num_workers (set by args_to_config)
+    staleness_alpha: float = 0.0
+    # Per-client NaN quarantine (graceful degradation): a non-finite
+    # client contribution is dropped from the aggregate — only that slot's
+    # mask is zeroed, reusing the valid_w machinery — and the client is
+    # benched for quarantine_rounds rounds via a (num_clients,) int vector
+    # in FedState. The global sticky ``aborted`` guard then fires only on
+    # server-side breaches (post-exclusion loss threshold). Off by
+    # default: the legacy all-or-nothing abort is bit-preserved.
+    client_quarantine: bool = False
+    quarantine_rounds: int = 5
+
     # parallelization (mesh, not processes)
     mesh_shape: Tuple[int, ...] = (1,)
     mesh_axis_names: Tuple[str, ...] = ("clients",)
@@ -150,6 +173,20 @@ class FedConfig:
         if self.offload_pipeline_depth < 1:
             raise ValueError("offload_pipeline_depth must be >= 1, got "
                              f"{self.offload_pipeline_depth}")
+        if self.server_mode not in SERVER_MODES:
+            raise ValueError(f"server_mode must be one of {SERVER_MODES}, "
+                             f"got {self.server_mode!r}")
+        if self.staleness_alpha < 0:
+            raise ValueError("staleness_alpha must be >= 0")
+        if self.quarantine_rounds < 1:
+            raise ValueError("quarantine_rounds must be >= 1")
+        if self.server_mode == "buffered":
+            if self.effective_buffer_m < 1:
+                raise ValueError("buffered server_mode needs buffer_m >= 1")
+            if self.client_state_offload:
+                raise ValueError("server_mode='buffered' is incompatible "
+                                 "with client_state_offload (contribution "
+                                 "slots already buffer the sampled rows)")
         # parse-time invariants, reference utils.py:225-228
         if self.mode == "fedavg":
             if self.local_batch_size != -1:
@@ -171,6 +208,12 @@ class FedConfig:
             raise ValueError("local_topk supports error_type in {none, local}")
         if self.mode == "true_topk" and self.error_type != "virtual":
             raise ValueError("true_topk requires error_type == 'virtual'")
+
+    @property
+    def effective_buffer_m(self) -> int:
+        """Buffer slots M for server_mode='buffered' (0 => num_workers,
+        the lock-step-equivalent default)."""
+        return self.buffer_m if self.buffer_m > 0 else self.num_workers
 
     # --- shapes -----------------------------------------------------------
     @property

@@ -152,6 +152,12 @@ class FedLearner:
                                        mesh=mesh,
                                        trainable_mask=trainable_mask)
         self._eval = build_eval_step(loss_val or loss_train, unflatten)
+        # stashed (post-padding) for subclasses that build additional
+        # jitted programs over the same loss/parameterization
+        # (federated/buffer.BufferedFedLearner)
+        self._loss_train = loss_train
+        self._round_unflatten = round_unflatten
+        self._trainable_mask = trainable_mask
         self.lr_schedule = lr_schedule or (lambda t: cfg.lr_scale)
         # optional (d,) per-coordinate LR multipliers (the reference's
         # per-param-group LR vector, fed_aggregator.py:411-427; built from

@@ -1,0 +1,147 @@
+"""Deterministic, seed-driven client behavior model (stragglers, dropouts,
+crashes) for the buffered asynchronous server (federated/buffer.py) and the
+synchronous baseline it is benchmarked against.
+
+Production cross-device FL is not the reference's lock-step simulator:
+clients straggle (latency tails of 10-100x are routine), drop out before
+starting, and crash mid-round (Papaya, Huba et al. MLSys 2022 §4; FedBuff,
+Nguyen et al. AISTATS 2022 §5). This module simulates exactly those three
+behaviors with one hard requirement: **every draw is a pure function of
+(seed, round, client)** — keyed Philox counters, no shared stream — so the
+schedule of which contribution lands in which buffer slot is independent of
+host iteration order and replays bit-identically from the seed
+(tests/test_buffered.py). Latency is in abstract simulated units (one unit
+= one base client round-trip), not wall seconds: the evidence grid
+(results.py --straggler) compares sync and buffered at a fixed *simulated*
+wall-clock budget.
+
+Semantics per (round, client):
+
+* **dropout** (prob ``dropout_prob``): the client never starts — no weight
+  pull, no compute, no upload. The sync server excludes it after waiting
+  ``sync_timeout``; the buffered server never sees it.
+* **crash** (prob ``crash_prob``, conditioned on starting): the client
+  pulls weights and computes, but its contribution never arrives.
+  Behaviorally identical to a dropout from the server's view; modeled
+  separately because the pull happened (``stats['crashed']`` counts the
+  wasted downloads — byte accounting follows the buffer, so crashed pulls
+  are intentionally not billed).
+* **latency**: log-normal around ``base_latency`` with spread
+  ``latency_sigma``; a fixed ``straggler_frac`` of CLIENTS (a per-client
+  property of the seed, not a per-round coin) multiply theirs by
+  ``straggler_mult`` — the chronic-tail regime where buffered aggregation
+  earns its keep.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+# stream tags: independent Philox keys per purpose, so adding a new draw
+# never shifts an existing one (replay stability across code versions)
+_TAG_STRAGGLER = 1
+_TAG_FATE = 2
+
+
+@dataclass(frozen=True)
+class ClientFate:
+    """One client's behavior in one round."""
+    started: bool    # pulled weights and began computing
+    arrives: bool    # contribution reaches the server
+    latency: float   # dispatch -> arrival, simulated units (inf if lost)
+
+
+class FaultModel:
+    """Seeded generator of per-(round, client) fates.
+
+    ``rounds`` here are COHORT indices (monotone per dispatch, supplied by
+    the caller) — not the server's ``round_idx``, which freezes on abort.
+    """
+
+    def __init__(self, seed: int, num_clients: int, *,
+                 base_latency: float = 1.0, latency_sigma: float = 0.25,
+                 straggler_frac: float = 0.0, straggler_mult: float = 10.0,
+                 dropout_prob: float = 0.0, crash_prob: float = 0.0,
+                 sync_timeout: float = None):
+        if not 0 <= dropout_prob < 1 or not 0 <= crash_prob < 1:
+            raise ValueError("dropout_prob / crash_prob must be in [0, 1)")
+        if base_latency <= 0 or straggler_mult < 1:
+            raise ValueError("base_latency must be > 0 and "
+                             "straggler_mult >= 1")
+        self.seed = int(seed)
+        self.num_clients = int(num_clients)
+        self.base_latency = float(base_latency)
+        self.latency_sigma = float(latency_sigma)
+        self.straggler_frac = float(straggler_frac)
+        self.straggler_mult = float(straggler_mult)
+        self.dropout_prob = float(dropout_prob)
+        self.crash_prob = float(crash_prob)
+        # what the sync server waits for a missing client before excluding
+        # it: provisioned at the chronic tail by default (it cannot know a
+        # client dropped rather than straggled until it has out-waited the
+        # slowest legitimate client)
+        self.sync_timeout = (float(sync_timeout) if sync_timeout is not None
+                             else self.base_latency * self.straggler_mult)
+        # chronic stragglers: a property of the CLIENT under this seed
+        self.straggler = np.array([
+            self._gen(_TAG_STRAGGLER, 0, c).random() < self.straggler_frac
+            for c in range(self.num_clients)])
+
+    def _gen(self, tag: int, round_idx: int, client: int):
+        """Order-independent stream: the counter IS the coordinates."""
+        bg = np.random.Philox(
+            counter=[0, int(round_idx), int(client), int(tag)],
+            key=[self.seed & 0xFFFFFFFFFFFFFFFF, 0])
+        return np.random.Generator(bg)
+
+    def fate(self, round_idx: int, client: int) -> ClientFate:
+        g = self._gen(_TAG_FATE, round_idx, client)
+        # fixed draw order within the stream (part of the replay contract)
+        u_drop, u_crash = g.random(), g.random()
+        lat = g.lognormal(mean=np.log(self.base_latency),
+                          sigma=self.latency_sigma)
+        if self.straggler[int(client) % self.num_clients]:
+            lat *= self.straggler_mult
+        if u_drop < self.dropout_prob:
+            return ClientFate(False, False, np.inf)
+        if u_crash < self.crash_prob:
+            return ClientFate(True, False, np.inf)
+        return ClientFate(True, True, float(lat))
+
+    def cohort_fates(self, round_idx: int, ids, valid=None):
+        """Fates for one sampled cohort. ``valid`` masks padded epoch-tail
+        slots (no client there — no fate). Returns (started, arrives,
+        latency) numpy arrays of shape (W,)."""
+        ids = np.asarray(ids)
+        W = ids.shape[0]
+        valid = (np.ones(W, bool) if valid is None
+                 else np.asarray(valid, bool))
+        started = np.zeros(W, bool)
+        arrives = np.zeros(W, bool)
+        latency = np.full(W, np.inf)
+        for w in range(W):
+            if not valid[w]:
+                continue
+            f = self.fate(round_idx, int(ids[w]))
+            started[w], arrives[w], latency[w] = (f.started, f.arrives,
+                                                  f.latency)
+        return started, arrives, latency
+
+    def sync_round(self, round_idx: int, ids, valid=None):
+        """The synchronous server's view of this cohort: which sampled
+        clients' contributions it gets (``present``), and how long the
+        lock-step barrier takes — the max arrival latency, plus the full
+        ``sync_timeout`` wait whenever any expected client never reports
+        (the barrier is the whole point of the comparison: ONE chronic
+        straggler or dropout stalls every other client in the round).
+        Returns (present (W,) bool, started (W,) bool, round_time)."""
+        started, arrives, latency = self.cohort_fates(round_idx, ids, valid)
+        valid = (np.ones(len(np.asarray(ids)), bool) if valid is None
+                 else np.asarray(valid, bool))
+        present = arrives & valid
+        t = float(latency[present].max()) if present.any() else 0.0
+        if (valid & ~arrives).any():
+            t = max(t, self.sync_timeout)
+        return present, started, t

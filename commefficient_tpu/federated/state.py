@@ -52,6 +52,34 @@ class ClientState:
 
 
 @struct.dataclass
+class BufferState:
+    """FedBuff-style contribution buffer (``server_mode='buffered'``).
+
+    ``M`` deposited client contributions awaiting the next server apply
+    (Nguyen et al., AISTATS 2022). The same container doubles as the
+    cohort output: ``buffer.cohort_step`` emits one with W slots (one per
+    worker) and the deposit scatters those slots into the server buffer
+    in arrival order. Client-state rows (``velocities``/``errors``/
+    ``weights``) ride along so the server can defer the row writeback to
+    apply time — exactly where the sync round scatters them, which is
+    what makes the lock-step buffered trajectory bit-identical to sync
+    (tests/test_buffered.py).
+    """
+    transmit: jax.Array         # (M, *transmit_shape)
+    loss_sum: jax.Array         # (M,)
+    metric_sums: jax.Array      # (M, n_metrics)
+    num_datapoints: jax.Array   # (M,)
+    download_floats: jax.Array  # (M,) f32: weights pulled at start
+    cid: jax.Array              # (M,) int32 client id (num_clients = empty)
+    start_version: jax.Array    # (M,) int32 weights_version computed against
+    valid: jax.Array            # (M,) bool: slot holds a real contribution
+    count: jax.Array            # () int32: filled slots
+    velocities: Optional[jax.Array] = None  # (M, d) client rows at finish
+    errors: Optional[jax.Array] = None      # (M, d)
+    weights: Optional[jax.Array] = None     # (M, d) topk_down stale weights
+
+
+@struct.dataclass
 class RoundOutput:
     """What one federated round produces (metrics are sums over datapoints)."""
     loss_sum: jax.Array

@@ -112,6 +112,11 @@ def fed_state_shardings(cfg: FedConfig, mesh: Mesh, axis: str = "clients"):
         last_changed=vec,
         client_last_round=_ns(mesh, axis),
         aborted=rep,
+        weights_version=rep,
+        quarantine=_ns(mesh, axis),
+        # server_mode='buffered' is single-chip (federated/buffer.py
+        # raises on a mesh), so the buffer subtree is always None here
+        buffer=None,
     )
 
 

@@ -147,6 +147,55 @@ def build_parser(default_lr: float = 0.4) -> argparse.ArgumentParser:
                         "'disallow' (default) makes any implicit "
                         "host<->device transfer at dispatch time an "
                         "error, proving the round stays async")
+    # buffered async server + fault model (federated/{buffer,faults}.py)
+    p.add_argument("--server_mode", choices=("sync", "buffered"),
+                   default="sync",
+                   help="'buffered' = FedBuff-style asynchronous server: "
+                        "contributions land in a --buffer_m slot buffer "
+                        "as they arrive (per --fault_* schedule) and the "
+                        "server applies whenever it fills, scaling each "
+                        "by staleness 1/(1+tau)^alpha. With no --fault_"
+                        "seed it runs lock-step and matches sync "
+                        "bit-for-bit at alpha=0 (tests/test_buffered.py)")
+    p.add_argument("--buffer_m", type=int, default=0,
+                   help="buffered server's apply threshold M; 0 = "
+                        "num_workers")
+    p.add_argument("--staleness_alpha", type=float, default=0.0,
+                   help="staleness-discount exponent alpha in "
+                        "s(tau)=1/(1+tau)^alpha (0 = no discounting)")
+    p.add_argument("--client_quarantine", action="store_true",
+                   help="per-client NaN quarantine: a non-finite client "
+                        "contribution is excluded from the aggregate "
+                        "(instead of aborting the run) and its client "
+                        "benched for --quarantine_rounds applied rounds; "
+                        "only a post-exclusion server-side breach trips "
+                        "the sticky abort")
+    p.add_argument("--quarantine_rounds", type=int, default=5,
+                   help="bench duration for a client whose update came "
+                        "back non-finite")
+    p.add_argument("--fault_seed", type=int, default=None,
+                   help="enable the seeded client fault model "
+                        "(federated/faults.py): per-(round, client) "
+                        "dropout/crash/latency draws, replayable from "
+                        "this seed. None = no faults (lock-step)")
+    p.add_argument("--fault_dropout_prob", type=float, default=0.0,
+                   help="per-(round, client) probability the client never "
+                        "starts")
+    p.add_argument("--fault_crash_prob", type=float, default=0.0,
+                   help="probability a started client crashes mid-round "
+                        "(pulls weights, never uploads)")
+    p.add_argument("--straggler_frac", type=float, default=0.0,
+                   help="fraction of clients that are CHRONIC stragglers "
+                        "under this fault seed (a per-client property)")
+    p.add_argument("--straggler_mult", type=float, default=10.0,
+                   help="latency multiplier for chronic stragglers")
+    p.add_argument("--base_latency", type=float, default=1.0,
+                   help="median client round-trip in simulated time units")
+    p.add_argument("--latency_sigma", type=float, default=0.25,
+                   help="log-normal spread of client latency")
+    p.add_argument("--dispatch_interval", type=float, default=None,
+                   help="simulated time between cohort dispatches "
+                        "(buffered server); None = base_latency")
     # DP
     p.add_argument("--dp", action="store_true", dest="do_dp")
     p.add_argument("--dp_mode", choices=DP_MODES, default="worker")
@@ -160,6 +209,44 @@ def args_to_config(args, **overrides) -> FedConfig:
     kwargs = {k: v for k, v in vars(args).items() if k in fields}
     kwargs.update(overrides)
     return FedConfig(**kwargs)
+
+
+def make_fault_model(args, num_clients: int):
+    """``--fault_*`` flags -> a seeded FaultModel, or None without
+    --fault_seed (lock-step)."""
+    if getattr(args, "fault_seed", None) is None:
+        return None
+    from commefficient_tpu.federated.faults import FaultModel
+    return FaultModel(
+        args.fault_seed, num_clients,
+        base_latency=args.base_latency,
+        latency_sigma=args.latency_sigma,
+        straggler_frac=args.straggler_frac,
+        straggler_mult=args.straggler_mult,
+        dropout_prob=args.fault_dropout_prob,
+        crash_prob=args.fault_crash_prob)
+
+
+def learner_factory(args, num_clients: int):
+    """(learner class, extra ctor kwargs) for ``--server_mode``.
+
+    The buffered server consumes the fault flags host-side
+    (BufferedFedLearner's event loop); sync training has no fault
+    adapter here — the sync-under-faults baseline lives in results.py's
+    straggler grid — so --fault_seed with sync mode fails loudly instead
+    of silently no-opping."""
+    if getattr(args, "server_mode", "sync") != "buffered":
+        if getattr(args, "fault_seed", None) is not None:
+            raise ValueError(
+                "--fault_seed needs --server_mode buffered (the sync "
+                "fault baseline is driven by results.py --straggler)")
+        from commefficient_tpu.federated.api import FedLearner
+        return FedLearner, {}
+    from commefficient_tpu.federated.buffer import BufferedFedLearner
+    return BufferedFedLearner, {
+        "fault_model": make_fault_model(args, num_clients),
+        "dispatch_interval": getattr(args, "dispatch_interval", None),
+    }
 
 
 def parse_mesh(spec: str):

@@ -20,7 +20,6 @@ import numpy as np
 
 from commefficient_tpu.data import FedBatcher, fed_datasets, val_batches
 from commefficient_tpu.data.transforms import get_transforms
-from commefficient_tpu.federated.api import FedLearner
 from commefficient_tpu.federated.losses import make_cv_loss
 from commefficient_tpu.models import get_model
 from commefficient_tpu.training.args import args_to_config, build_parser
@@ -88,10 +87,15 @@ def build_learner(args, sample_input, num_classes, channels, mesh=None):
 
         from commefficient_tpu.utils.params import scalar_lr_multipliers
         lr_vec = partial(scalar_lr_multipliers, scalar_factor=factor)
-    return FedLearner(model, cfg, loss, loss, jax.random.PRNGKey(args.seed),
-                      sample_input, lr_schedule=sched, mesh=mesh,
-                      init_params=init_params, trainable_mask=trainable_mask,
-                      lr_scale_vec=lr_vec)
+    # --server_mode buffered swaps in the FedBuff event-loop learner
+    # (federated/buffer.py) with the --fault_* schedule; sync stays the
+    # plain FedLearner
+    from commefficient_tpu.training.args import learner_factory
+    cls, extra = learner_factory(args, num_clients)
+    return cls(model, cfg, loss, loss, jax.random.PRNGKey(args.seed),
+               sample_input, lr_schedule=sched, mesh=mesh,
+               init_params=init_params, trainable_mask=trainable_mask,
+               lr_scale_vec=lr_vec, **extra)
 
 
 def train(args, mesh=None, max_rounds=None, log=True):
@@ -206,6 +210,11 @@ def train(args, mesh=None, max_rounds=None, log=True):
             # window instead of per round. The epoch tail flushes a
             # shorter window (one extra compile for that K).
             scan_k = max(1, int(getattr(args, "scan_rounds", 1) or 1))
+            if scan_k > 1 and getattr(args, "server_mode", "sync") != "sync":
+                raise ValueError("--scan_rounds > 1 is a sync-mode "
+                                 "optimization; the buffered server "
+                                 "dispatches cohorts through a host event "
+                                 "loop")
             window = learner.scan_window(scan_k) if scan_k > 1 else None
 
             def check_all(outs):
@@ -273,6 +282,21 @@ def train(args, mesh=None, max_rounds=None, log=True):
     finally:
         if writer:
             writer.close()
+
+    if hasattr(learner, "flush_faults"):
+        # buffered server end-of-training barrier: deliver every in-flight
+        # contribution and apply whatever partial buffer remains, so the
+        # final weights/byte totals account for all dispatched work
+        learner.flush_faults()
+        row["sim_time"] = learner.sim_time
+        # flush-triggered applies moved bytes after the last epoch row
+        row["down (MiB)"] = learner.total_download_bytes / 2**20
+        row["up (MiB)"] = learner.total_upload_bytes / 2**20
+        if log:
+            print(f"buffered server: {learner.applies_done} applies over "
+                  f"{learner.cohorts_done} cohorts, sim_time="
+                  f"{learner.sim_time:.1f} units, faults="
+                  f"{learner.fault_stats}")
 
     if args.do_checkpoint:
         from commefficient_tpu.utils.checkpoint import save_checkpoint

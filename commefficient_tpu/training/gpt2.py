@@ -285,10 +285,20 @@ def train(args, mesh=None, max_rounds=None, log=True):
             print(f"--mesh model={mesh.shape['model']}: TP-sharding GPT2 "
                   "params inside the federated round")
 
-    learner = FedLearner(_Wrap(), cfg, loss_tr, loss_val,
-                         jax.random.PRNGKey(args.seed), sample_in,
-                         lr_schedule=sched, mesh=mesh,
-                         init_params=init_params, param_specs=param_specs)
+    # --server_mode buffered swaps in the FedBuff event-loop learner
+    # (federated/buffer.py; single-chip — it rejects a mesh itself)
+    from commefficient_tpu.training.args import learner_factory
+    learner_cls, learner_extra = learner_factory(args, cfg.num_clients)
+    if learner_cls is not FedLearner and (getattr(args, "scan_rounds", 1)
+                                          or 1) > 1:
+        raise ValueError("--scan_rounds > 1 is a sync-mode optimization; "
+                         "the buffered server dispatches cohorts through "
+                         "a host event loop")
+    learner = learner_cls(_Wrap(), cfg, loss_tr, loss_val,
+                          jax.random.PRNGKey(args.seed), sample_in,
+                          lr_schedule=sched, mesh=mesh,
+                          init_params=init_params, param_specs=param_specs,
+                          **learner_extra)
 
     table = TableLogger() if log else None
     writer = None
@@ -414,6 +424,11 @@ def train(args, mesh=None, max_rounds=None, log=True):
     finally:
         if writer:
             writer.close()
+
+    if hasattr(learner, "flush_faults"):
+        # buffered server end-of-training barrier (see training/cv.py)
+        learner.flush_faults()
+        row["sim_time"] = learner.sim_time
 
     if log and not args.do_test:
         gen_model = init_model

@@ -40,6 +40,13 @@ def save_checkpoint(path: str, learner, name: str = "model",
     os.makedirs(path, exist_ok=True)
     fn = os.path.join(path, f"{name}.npz")
     flat, paths, _ = _state_arrays(learner.state)
+    # the buffered server's in-flight contribution buffer is deliberately
+    # NOT checkpointed: contributions are transient (a resume restarts
+    # with an empty buffer and the fault model's schedule), and skipping
+    # it keeps buffered checkpoints loadable into sync learners
+    keep = [i for i, p in enumerate(paths) if not p.startswith(".buffer")]
+    flat = [flat[i] for i in keep]
+    paths = [paths[i] for i in keep]
     # record which leaf is the global weight vector so finetune can load it
     # without reconstructing this run's FedState treedef (and without
     # storing the dominant array twice)
@@ -66,8 +73,16 @@ def save_checkpoint(path: str, learner, name: str = "model",
 
 
 #: leaves that may legitimately be absent from an older checkpoint, and the
-#: value to backfill (state fields grown after the format was introduced)
-_BACKFILL = {".aborted": lambda cur: np.zeros((), bool)}
+#: value to backfill (state fields grown after the format was introduced).
+#: The lambda receives the learner's CURRENT leaf so shaped fields can size
+#: themselves (e.g. quarantine's (num_clients,)).
+_BACKFILL = {
+    ".aborted": lambda cur: np.zeros((), bool),
+    # pre-versioning checkpoints: version 0 is safe — sync rounds never
+    # read it and a buffered resume just restarts staleness at zero
+    ".weights_version": lambda cur: np.zeros((), np.int32),
+    ".quarantine": lambda cur: np.zeros(np.shape(cur), np.int32),
+}
 
 
 def load_checkpoint(fn: str, learner) -> None:
@@ -90,11 +105,15 @@ def load_checkpoint(fn: str, learner) -> None:
                     f"checkpoint {fn} has state leaves {sorted(unknown)} the "
                     f"learner doesn't — config/mode mismatch")
             restored = []
-            for p in paths:
-                if p in by_path:
+            for p, cur in zip(paths, flat):
+                if p.startswith(".buffer"):
+                    # never saved (see save_checkpoint): a buffered
+                    # learner resumes with its current (empty) buffer
+                    restored.append(cur)
+                elif p in by_path:
                     restored.append(by_path[p])
                 elif p in _BACKFILL:
-                    restored.append(_BACKFILL[p](None))
+                    restored.append(_BACKFILL[p](cur))
                 else:
                     raise ValueError(
                         f"checkpoint {fn} is missing state leaf {p!r} — "

@@ -555,6 +555,217 @@ def write_regime_markdown(rows: list,
         f.write("\n".join(lines))
 
 
+# --- the straggler study (buffered async vs sync under faults) --------------
+# Both arms run the SAME digits/local_topk recipe, the SAME seeded
+# FaultModel parameters, and stop at the SAME simulated wall-clock budget;
+# the only difference is the aggregation policy. The sync server pays the
+# barrier: each round costs the slowest present client (or the full
+# sync_timeout whenever any sampled client never reports — it cannot
+# distinguish a dropout from a straggler until it has out-waited the
+# chronic tail). The buffered server dispatches a cohort every
+# dispatch_interval of simulated time and applies whenever M contributions
+# have arrived, so stragglers overlap instead of serializing.
+#
+# Concurrency accounting (stated, not hidden): with dispatch_interval =
+# base_latency the buffered server keeps ~W * E[latency]/base clients in
+# flight (~2x sync's W at straggler_frac 0.25 x mult 5). That matches
+# FedBuff's operating model — the async server exists to keep more
+# clients productively in flight — but it means the comparison is
+# "policy at its natural concurrency", not "identical client-hours".
+STRAGGLER_SEEDS = (21, 42, 77)
+STRAGGLER_ALPHAS = (0.0, 0.3, 0.6)
+STRAGGLER_FAULTS = dict(straggler_frac=0.25, straggler_mult=5.0,
+                        dropout_prob=0.10, crash_prob=0.02,
+                        base_latency=1.0, latency_sigma=0.25)
+STRAGGLER_BUDGET = 600.0   # sim-seconds; ~60 data epochs for buffered
+
+
+def _straggler_run(arm: str, alpha: float, seed: int, quick: bool) -> dict:
+    from commefficient_tpu.data.batching import FedBatcher, val_batches
+    from commefficient_tpu.federated.faults import FaultModel
+    from commefficient_tpu.training.cv import (build_learner, build_parser,
+                                               make_dataset)
+
+    argv = task_flags("digits", quick=False) + mode_flags("local_topk",
+                                                          "digits")
+    args = build_parser().parse_args(argv)
+    args.lr_scale = 0.05          # the digits/local_topk tuned point
+    args.seed = int(seed)
+    if arm == "buffered":
+        args.server_mode = "buffered"
+        args.staleness_alpha = float(alpha)
+        args.fault_seed = 1000 + int(seed)
+        args.dispatch_interval = STRAGGLER_FAULTS["base_latency"]
+        for k in ("straggler_frac", "straggler_mult", "base_latency",
+                  "latency_sigma"):
+            setattr(args, k, STRAGGLER_FAULTS[k])
+        args.fault_dropout_prob = STRAGGLER_FAULTS["dropout_prob"]
+        args.fault_crash_prob = STRAGGLER_FAULTS["crash_prob"]
+
+    train_set = make_dataset(args, train=True)
+    val_set = make_dataset(args, train=False)
+    args.num_clients = train_set.num_clients
+    batcher = FedBatcher(train_set, args.num_workers, args.local_batch_size,
+                         seed=args.seed)
+    ids0, cols0, _ = next(iter(batcher.epoch()))
+    learner = build_learner(args, cols0[0][0][:1], train_set.num_classes, 1)
+
+    T = 40.0 if quick else STRAGGLER_BUDGET
+    np.random.seed(args.seed)
+    t0 = time.time()
+
+    def endless_rounds():
+        while True:
+            yield from batcher.epoch()
+
+    rounds = applies = 0
+    sim = 0.0
+    if arm == "sync":
+        # the sync arm drives the SAME fault schedule host-side: absent
+        # clients' mask rows zero out (round.py treats an all-zero mask
+        # row as a non-participant — no bytes, no contribution) and the
+        # barrier bills the straggler tail / timeout to the sim clock
+        fm = FaultModel(1000 + int(seed), args.num_clients,
+                        **STRAGGLER_FAULTS)
+        for ids, cols, mask in endless_rounds():
+            if sim >= T:
+                break
+            present, _, dt = fm.sync_round(rounds, ids,
+                                           valid=mask.sum(axis=1) > 0)
+            sim += dt
+            m = mask * present[:, None].astype(np.float32)
+            # LR schedule indexed by SIM-CLOCK fraction on both arms, so
+            # neither arm's anneal depends on how many rounds it fit
+            learner.train_round(ids, cols, m,
+                                epoch_frac=min(sim / T, 1.0)
+                                * args.num_epochs)
+            rounds += 1
+        applies = rounds
+        sim_final = sim
+    else:
+        for ids, cols, mask in endless_rounds():
+            clock = learner.cohorts_done * learner.dispatch_interval
+            if clock >= T:
+                break
+            # finalize every cohort: byte totals accumulate there, and a
+            # TinyMLP metric sync costs ~nothing
+            learner.finalize_round_metrics(learner.train_round_async(
+                ids, cols, mask,
+                epoch_frac=min(clock / T, 1.0) * args.num_epochs))
+        learner.flush_faults()
+        rounds = learner.cohorts_done
+        applies = learner.applies_done
+        sim_final = max(learner.sim_time,
+                        learner.cohorts_done * learner.dispatch_interval)
+
+    val = learner.evaluate(val_batches(val_set, args.valid_batch_size))
+    label = arm if arm == "sync" else f"buffered_a{alpha:g}"
+    row = {
+        "arm": label, "alpha": (None if arm == "sync" else float(alpha)),
+        "seed": int(seed), "sim_budget": T,
+        "rounds": int(rounds), "applies": int(applies),
+        "sim_time": round(float(sim_final), 1),
+        "aborted": bool(np.asarray(learner.state.aborted)),
+        "final_test_acc": float(val["metrics"][0]),
+        "upload_mib": round(learner.total_upload_bytes / 2**20, 2),
+        "download_mib": round(learner.total_download_bytes / 2**20, 2),
+        "fault_stats": (dict(learner.fault_stats)
+                        if hasattr(learner, "fault_stats") else None),
+        "wall_seconds": round(time.time() - t0, 1),
+    }
+    print(f"[straggler/{label} s{seed}] acc={row['final_test_acc']:.4f} "
+          f"rounds={rounds} applies={applies} "
+          f"up={row['upload_mib']:.1f}MiB ({row['wall_seconds']:.0f}s)",
+          flush=True)
+    return row
+
+
+def run_straggler(out: str = "RESULTS_straggler",
+                  quick: bool = False) -> list:
+    """Resumable sync-vs-buffered grid at a fixed simulated wall-clock
+    budget: seeds x (sync, buffered at each staleness alpha)."""
+    if quick:
+        out = out + "_smoke"
+    path = f"{out}.json"
+    rows = []
+    if os.path.exists(path) and not quick:
+        with open(path) as f:
+            rows = json.load(f)["results"]
+    done = {(r["arm"], r["seed"]) for r in rows}
+    seeds = STRAGGLER_SEEDS[:1] if quick else STRAGGLER_SEEDS
+    alphas = STRAGGLER_ALPHAS[1:2] if quick else STRAGGLER_ALPHAS
+    jobs = [("sync", 0.0, s) for s in seeds]
+    jobs += [("buffered", a, s) for a in alphas for s in seeds]
+    for arm, alpha, seed in jobs:
+        label = arm if arm == "sync" else f"buffered_a{alpha:g}"
+        if (label, seed) in done:
+            continue
+        rows.append(_straggler_run(arm, alpha, seed, quick))
+        with open(path, "w") as f:
+            json.dump({"results": rows, "faults": STRAGGLER_FAULTS,
+                       "budget": STRAGGLER_BUDGET if not quick else 40.0,
+                       "seeds": list(seeds)}, f, indent=1)
+    return rows
+
+
+def write_straggler_markdown(rows: list,
+                             path: str = "RESULTS_straggler.md") -> None:
+    lines = [
+        "# Stragglers and dropouts — buffered async vs the sync barrier",
+        "",
+        "digits/local_topk (TinyMLP d=2,410, 100 clients non-iid, 10 "
+        "sampled per round, k=120), both arms under the SAME seeded fault "
+        f"model ({STRAGGLER_FAULTS['straggler_frac']:.0%} chronic "
+        f"stragglers at {STRAGGLER_FAULTS['straggler_mult']:g}x latency, "
+        f"{STRAGGLER_FAULTS['dropout_prob']:.0%} dropout + "
+        f"{STRAGGLER_FAULTS['crash_prob']:.0%} crash per client-round) and "
+        "the SAME simulated wall-clock budget. The sync server pays the "
+        "barrier — a round costs the slowest present client, or the full "
+        "timeout whenever anyone sampled never reports; the buffered "
+        "server (FedBuff-style, staleness weight 1/(1+tau)^alpha) keeps "
+        "dispatching cohorts and applies every M arrivals, so stragglers "
+        "overlap. Its natural concurrency is ~2x sync's in-flight clients "
+        "at these fault rates (see results.py for the accounting).",
+        "",
+        "| arm | seed | rounds | applies | final val acc | up (MiB) |",
+        "|---|---|---|---|---|---|",
+    ]
+    for r in sorted(rows, key=lambda r: (r["arm"], r["seed"])):
+        acc = "DIVERGED" if r["aborted"] else f"{r['final_test_acc']:.4f}"
+        lines.append(f"| {r['arm']} | {r['seed']} | {r['rounds']} | "
+                     f"{r['applies']} | {acc} | {r['upload_mib']:.1f} |")
+    arms = sorted({r["arm"] for r in rows})
+    lines.append("")
+    lines.append("| arm | mean acc | min..max | mean applies |")
+    lines.append("|---|---|---|---|")
+    means = {}
+    for arm in arms:
+        sub = [r for r in rows if r["arm"] == arm and not r["aborted"]]
+        if not sub:
+            lines.append(f"| {arm} | DIVERGED | — | — |")
+            continue
+        accs = [r["final_test_acc"] for r in sub]
+        means[arm] = float(np.mean(accs))
+        lines.append(f"| {arm} | {np.mean(accs):.4f} | "
+                     f"{min(accs):.4f}..{max(accs):.4f} | "
+                     f"{np.mean([r['applies'] for r in sub]):.0f} |")
+    if "sync" in means and len(means) > 1:
+        best_buf = max((a for a in means if a != "sync"),
+                       key=lambda a: means[a])
+        delta = means[best_buf] - means["sync"]
+        verdict = ("confirms" if delta > 0 else "REFUTES")
+        lines.append("")
+        lines.append(
+            f"At this budget the best buffered arm ({best_buf}) lands "
+            f"{delta:+.4f} accuracy vs sync — this {verdict} the claim "
+            "that buffered aggregation dominates under a straggler/"
+            "dropout regime at fixed wall-clock. The alpha sweep reads "
+            "directly off the summary table above.")
+    lines.append("")
+    with open(path, "w") as f:
+        f.write("\n".join(lines))
+
+
 def best_lr(rows: list, mode: str) -> str:
     """Tuned-best LR for a mode: highest base-seed accuracy, diverged runs
     excluded (a diverging LR is outside the feasible set, not a 0-acc run)."""
@@ -885,11 +1096,25 @@ def main():
                     help="run the fixed-round-budget FedAvg-regime grid "
                          "(participation x local epochs vs sketch) on "
                          "patches32 (resumable)")
+    ap.add_argument("--straggler", action="store_true",
+                    help="run the sync-vs-buffered straggler/dropout grid "
+                         "(fixed simulated wall-clock budget, staleness "
+                         "alpha sweep) on digits (resumable)")
     ap.add_argument("--out", default=None,
                     help="artifact basename (default RESULTS, or "
                          "RESULTS_smoke under --quick so a smoke run can "
                          "never clobber or leak into the real artifact)")
     args = ap.parse_args()
+    if args.straggler:
+        rows = run_straggler(quick=args.quick)
+        if args.quick:
+            write_straggler_markdown(rows, "RESULTS_straggler_smoke.md")
+            print(f"quick straggler smoke done ({len(rows)} rows; real "
+                  "artifacts untouched)")
+            return
+        write_straggler_markdown(rows)
+        print("wrote RESULTS_straggler.{json,md}")
+        return
     if args.regime:
         rows = run_regime(quick=args.quick)
         if args.quick:

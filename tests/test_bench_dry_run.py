@@ -34,12 +34,13 @@ def test_registry_covers_every_row():
     a row cannot exist in one mode and be silently skipped by the
     other."""
     names = [n for n, _ in bench._bench_rows()]
-    assert len(names) == len(set(names)) == 10
+    assert len(names) == len(set(names)) == 11
     for must in ("cifar10_resnet9_fed_rounds_per_sec",
                  "gpt2_personachat_tokens_per_sec_chip_flash_attn",
                  "flash_attn_t256_parity_dropout_kernel_ab",
                  "gpt2_longcontext_4k_blockwise_tokens_per_sec_chip",
-                 "offload_gather_scatter_overlap"):
+                 "offload_gather_scatter_overlap",
+                 "buffered_fedbuff_round_overhead"):
         assert must in names
 
 

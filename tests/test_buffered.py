@@ -1,0 +1,438 @@
+"""Buffered asynchronous aggregation (federated/buffer.py), the seeded
+fault model (federated/faults.py), and per-client NaN quarantine.
+
+The load-bearing claims, each pinned here:
+
+* **Degeneracy**: with no fault model and staleness_alpha=0, the buffered
+  learner IS the sync learner — BITWISE, through padded epoch tails and a
+  NaN-guard abort (the same discipline as tests/test_offload_async.py).
+* **Quarantine**: one client's non-finite update drops only that
+  contribution and benches only that client for quarantine_rounds applied
+  rounds; the run completes, ``aborted`` stays False, and the same seed
+  replays the same weights bit-for-bit.
+* **Replay**: the fault schedule is a pure function of (seed, round,
+  client) — independent of query order — so a faulted run replays
+  bit-identically.
+* **Sticky abort**: once the device guard latches, every later round in a
+  ScanWindow is a state no-op (weights, round_idx, byte accounting all
+  frozen).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from commefficient_tpu.config import FedConfig
+from commefficient_tpu.federated.api import FedLearner
+from commefficient_tpu.federated.buffer import BufferedFedLearner
+from commefficient_tpu.federated.faults import FaultModel
+from commefficient_tpu.federated.losses import make_cv_loss
+from commefficient_tpu.models import TinyMLP
+
+N_CLIENTS = 6
+W = 2
+
+CFG = dict(mode="local_topk", error_type="local", local_momentum=0.9, k=3)
+
+
+def make_learner(server_mode="sync", fault_model=None,
+                 dispatch_interval=None, **cfg_kw):
+    kw = dict(CFG)
+    kw.update(cfg_kw)
+    model = TinyMLP(num_classes=2, hidden=4)
+    cfg = FedConfig(weight_decay=0, num_workers=W, num_clients=N_CLIENTS,
+                    lr_scale=0.05, server_mode=server_mode, **kw)
+    loss = make_cv_loss(model)
+    if server_mode == "buffered":
+        return BufferedFedLearner(model, cfg, loss, None,
+                                  jax.random.PRNGKey(1),
+                                  np.zeros((1, 8), np.float32),
+                                  fault_model=fault_model,
+                                  dispatch_interval=dispatch_interval)
+    return FedLearner(model, cfg, loss, None, jax.random.PRNGKey(1),
+                      np.zeros((1, 8), np.float32))
+
+
+def scenario(seed=0, nan_round=4, n_rounds=8, ids_fn=None):
+    """Rounds with every hazard: consecutive rounds share a client
+    (ids [r, r+1] mod N), a padded epoch-tail slot at round 2, a NaN
+    batch at ``nan_round`` on worker 0."""
+    rng = np.random.RandomState(seed)
+    rounds = []
+    for r in range(n_rounds):
+        ids = (np.array([r % N_CLIENTS, (r + 1) % N_CLIENTS])
+               if ids_fn is None else np.asarray(ids_fn(r)))
+        Xb = rng.randn(W, 4, 8).astype(np.float32)
+        yb = rng.randint(0, 2, (W, 4)).astype(np.int32)
+        mask = np.ones((W, 4), np.float32)
+        if r == 2:
+            mask = mask.copy()
+            mask[-1] = 0.0          # padded epoch-tail slot
+        if r == nan_round:
+            Xb[0, 0, 0] = np.nan    # worker 0's client goes non-finite
+        rounds.append((ids, (Xb, yb), mask))
+    return rounds
+
+
+def run(ln, rounds, keep_raw=()):
+    outs = []
+    for ids, batch, mask in rounds:
+        raw = ln.train_round_async(ids, batch, mask)
+        extra = {k: float(jax.device_get(raw[k]))
+                 for k in keep_raw if k in raw}
+        out = ln.finalize_round_metrics(raw)
+        out.update(extra)
+        outs.append(out)
+    return outs
+
+
+def assert_same_trajectory(ln_a, ln_b, outs_a, outs_b):
+    for r, (a, b) in enumerate(zip(outs_a, outs_b)):
+        # same math, same reduction order -> bitwise equality
+        np.testing.assert_array_equal(a["loss"], b["loss"],
+                                      err_msg=f"round {r}")
+        assert a["aborted"] == b["aborted"], r
+        assert a["download_bytes"] == b["download_bytes"], r
+        assert a["upload_bytes"] == b["upload_bytes"], r
+        np.testing.assert_array_equal(a["update_l2"], b["update_l2"],
+                                      err_msg=f"round {r}")
+    for field in ("weights", "last_changed", "client_last_round",
+                  "quarantine"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ln_a.state, field)),
+            np.asarray(getattr(ln_b.state, field)), err_msg=field)
+    for field in ("velocities", "errors"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ln_a.state.clients, field)),
+            np.asarray(getattr(ln_b.state.clients, field)), err_msg=field)
+    np.testing.assert_array_equal(np.asarray(ln_a.state.opt.Vvelocity),
+                                  np.asarray(ln_b.state.opt.Vvelocity))
+    assert int(ln_a.state.round_idx) == int(ln_b.state.round_idx)
+    assert ln_a.total_download_bytes == ln_b.total_download_bytes
+    assert ln_a.total_upload_bytes == ln_b.total_upload_bytes
+
+
+# ---------------------------------------------------------------------------
+# degeneracy: buffered(M=W, no faults, alpha=0) == sync, bitwise
+# ---------------------------------------------------------------------------
+
+def test_lockstep_matches_sync_bitwise():
+    ln_s = make_learner("sync")
+    ln_b = make_learner("buffered")
+    rounds = scenario()
+    outs_s = run(ln_s, rounds)
+    outs_b = run(ln_b, rounds)
+    # the scenario really aborted mid-sequence (guard latched) — without
+    # this the equivalence can go vacuous
+    assert outs_s[4]["aborted"] and outs_s[-1]["aborted"]
+    assert not outs_s[3]["aborted"]
+    assert_same_trajectory(ln_s, ln_b, outs_s, outs_b)
+    assert ln_b.applies_done == len(rounds)
+    # version tracks round_idx in lock-step
+    assert int(ln_b.state.weights_version) == int(ln_b.state.round_idx)
+
+
+def test_lockstep_matches_sync_bitwise_with_quarantine():
+    # quarantine ON on both sides: the sync round and the buffered apply
+    # share the where-masked exclusion dataflow, so the degeneracy holds
+    # there too — and the NaN round no longer aborts either side
+    ln_s = make_learner("sync", client_quarantine=True, quarantine_rounds=2)
+    ln_b = make_learner("buffered", client_quarantine=True,
+                        quarantine_rounds=2)
+    rounds = scenario()
+    outs_s = run(ln_s, rounds)
+    outs_b = run(ln_b, rounds)
+    assert not outs_s[-1]["aborted"] and not outs_b[-1]["aborted"]
+    assert_same_trajectory(ln_s, ln_b, outs_s, outs_b)
+    assert np.isfinite(np.asarray(ln_b.state.weights)).all()
+
+
+def test_buffered_rejects_mesh_and_wrong_mode():
+    model = TinyMLP(num_classes=2, hidden=4)
+    cfg = FedConfig(weight_decay=0, num_workers=W, num_clients=N_CLIENTS,
+                    lr_scale=0.05, server_mode="sync", **CFG)
+    with pytest.raises(ValueError, match="server_mode"):
+        BufferedFedLearner(model, cfg, make_cv_loss(model), None,
+                           jax.random.PRNGKey(1),
+                           np.zeros((1, 8), np.float32))
+
+
+def test_buffered_incompatible_with_offload():
+    with pytest.raises(ValueError, match="client_state_offload"):
+        FedConfig(num_workers=W, num_clients=N_CLIENTS,
+                  server_mode="buffered", client_state_offload=True,
+                  **CFG).validate()
+
+
+# ---------------------------------------------------------------------------
+# per-client NaN quarantine
+# ---------------------------------------------------------------------------
+
+# round 4's worker 0 (the NaN batch) is client 4; rounds 5 and 6 resample
+# client 4 so the bench is observable, round 7 lets it age out
+QUARANTINE_IDS = [[0, 1], [2, 3], [4, 5], [0, 1],
+                  [4, 5], [4, 1], [4, 2], [0, 1]]
+
+
+@pytest.mark.parametrize("server_mode", ["sync", "buffered"])
+def test_quarantine_drops_only_bad_contribution(server_mode):
+    ln = make_learner(server_mode, client_quarantine=True,
+                      quarantine_rounds=2)
+    rounds = scenario(ids_fn=lambda r: QUARANTINE_IDS[r])
+    outs = run(ln, rounds, keep_raw=("dropped_contributions",
+                                     "num_quarantined"))
+    # the run COMPLETES: no abort, finite weights, finite reported loss
+    # after the poisoned round
+    assert not any(o["aborted"] for o in outs)
+    assert np.isfinite(np.asarray(ln.state.weights)).all()
+    assert all(np.isfinite(o["loss"]) for o in outs)
+    # exactly the poisoned contribution was dropped, exactly once
+    assert [o["dropped_contributions"] for o in outs] == \
+        [0, 0, 0, 0, 1, 0, 0, 0]
+    # client 4 benched for 2 applied rounds: rounds 5 and 6 bill only the
+    # OTHER worker's upload; round 7 is back to full
+    full = outs[0]["upload_bytes"]
+    assert outs[5]["upload_bytes"] == outs[6]["upload_bytes"] == full / 2
+    assert outs[7]["upload_bytes"] == full
+    assert [o["num_quarantined"] for o in outs] == [0, 0, 0, 0, 1, 1, 0, 0]
+    assert (np.asarray(ln.state.quarantine) == 0).all()
+    # same seed, same schedule -> bit-identical replay
+    ln2 = make_learner(server_mode, client_quarantine=True,
+                       quarantine_rounds=2)
+    outs2 = run(ln2, rounds)
+    assert_same_trajectory(ln, ln2, [], [])
+    np.testing.assert_array_equal(
+        [o["loss"] for o in outs], [o["loss"] for o in outs2])
+
+
+def test_quarantine_still_aborts_on_server_breach():
+    # quarantine handles CLIENT failures; a post-exclusion divergence past
+    # nan_threshold is a SERVER breach and must still latch the sticky
+    # abort (every sampled client healthy but the loss beyond the bar)
+    ln = make_learner("sync", client_quarantine=True, nan_threshold=1e-6)
+    rounds = scenario(nan_round=None, n_rounds=3)
+    outs = run(ln, rounds)
+    assert outs[0]["aborted"] and outs[-1]["aborted"]
+    assert int(ln.state.round_idx) == 0
+
+
+def test_quarantine_forces_per_worker_path():
+    from commefficient_tpu.federated.round import fused_clients_eligible
+    base = dict(num_workers=W, num_clients=N_CLIENTS, mode="uncompressed")
+    assert fused_clients_eligible(FedConfig(**base))
+    assert not fused_clients_eligible(
+        FedConfig(client_quarantine=True, **base))
+
+
+# ---------------------------------------------------------------------------
+# fault model: seeded, order-independent, replayable
+# ---------------------------------------------------------------------------
+
+def test_fault_model_order_independent():
+    kw = dict(straggler_frac=0.3, dropout_prob=0.1, crash_prob=0.05)
+    fm1 = FaultModel(7, N_CLIENTS, **kw)
+    fm2 = FaultModel(7, N_CLIENTS, **kw)
+    late = fm2.cohort_fates(5, [1, 2, 3])       # query round 5 FIRST
+    for r in range(5):
+        fm1.cohort_fates(r, [1, 2, 3])          # burn earlier rounds
+    for a, b in zip(late, fm1.cohort_fates(5, [1, 2, 3])):
+        np.testing.assert_array_equal(a, b)
+    # a different seed draws a different schedule
+    other = FaultModel(8, N_CLIENTS, **kw).cohort_fates(5, [1, 2, 3])
+    assert not all(np.array_equal(a, b) for a, b in zip(late, other))
+
+
+def test_fault_model_rates_and_stragglers():
+    fm = FaultModel(3, 50, straggler_frac=0.2, straggler_mult=10.0,
+                    dropout_prob=0.2, crash_prob=0.0)
+    fates = [fm.fate(r, c) for r in range(100) for c in range(50)]
+    started = np.mean([f.started for f in fates])
+    assert 0.75 < started < 0.85
+    # chronic stragglers are a per-client property: the same clients are
+    # slow in every round, ~straggler_mult over the base latency
+    lat = np.array([[fm.fate(r, c).latency for c in range(50)]
+                    for r in range(5)])
+    med = np.nanmedian(np.where(np.isinf(lat), np.nan, lat), axis=0)
+    assert ((med > 5.0) == fm.straggler).all()
+    assert 0.1 < fm.straggler.mean() < 0.35
+
+
+def test_fault_model_sync_round_barrier():
+    # one dropout escalates the sync round to the full timeout — the
+    # lock-step barrier cost the buffered server exists to avoid
+    fm = FaultModel(0, 10, dropout_prob=0.0, latency_sigma=0.1,
+                    straggler_mult=20.0)
+    _, _, t_clean = fm.sync_round(0, list(range(10)))
+    assert t_clean < 2.0
+    fm2 = FaultModel(0, 10, dropout_prob=0.5, latency_sigma=0.1,
+                     straggler_mult=20.0)
+    present, _, t_dropped = fm2.sync_round(0, list(range(10)))
+    assert not present.all()
+    assert t_dropped == fm2.sync_timeout
+
+
+def test_fault_model_validation():
+    with pytest.raises(ValueError):
+        FaultModel(0, 4, dropout_prob=1.0)
+    with pytest.raises(ValueError):
+        FaultModel(0, 4, straggler_mult=0.5)
+
+
+# ---------------------------------------------------------------------------
+# buffered event loop under faults
+# ---------------------------------------------------------------------------
+
+def faulted_learner(seed=3, alpha=0.0, **cfg_kw):
+    fm = FaultModel(seed, N_CLIENTS, straggler_frac=0.3,
+                    straggler_mult=5.0, dropout_prob=0.15,
+                    crash_prob=0.05)
+    return make_learner("buffered", fault_model=fm, buffer_m=3,
+                        staleness_alpha=alpha, **cfg_kw)
+
+
+def test_faulted_run_replays_bitwise():
+    rounds = scenario(nan_round=None, n_rounds=12)
+    ln1 = faulted_learner()
+    outs1 = run(ln1, rounds)
+    fl1 = ln1.flush_faults()
+    ln2 = faulted_learner()
+    outs2 = run(ln2, rounds)
+    fl2 = ln2.flush_faults()
+    np.testing.assert_array_equal(np.asarray(ln1.state.weights),
+                                  np.asarray(ln2.state.weights))
+    assert [o["loss"] for o in outs1] == [o["loss"] for o in outs2]
+    assert ln1.sim_time == ln2.sim_time
+    assert ln1.fault_stats == ln2.fault_stats
+    assert (fl1 is None) == (fl2 is None)
+    # the schedule actually exercised the faulty paths
+    assert ln1.fault_stats["dropouts"] + ln1.fault_stats["crashes"] > 0
+    assert ln1.applies_done > 0
+    assert ln1.total_upload_bytes == ln2.total_upload_bytes
+
+
+def test_cross_cohort_buffer_accumulation():
+    # deterministic latencies (sigma=0, no stragglers): every client
+    # arrives exactly one dispatch later, so with M=4 and W=2 the server
+    # applies every second cohort — cross-cohort accumulation, no barrier
+    fm = FaultModel(0, N_CLIENTS, latency_sigma=1e-9, base_latency=1.0)
+    ln = make_learner("buffered", fault_model=fm, buffer_m=4,
+                      dispatch_interval=1.0)
+    rounds = scenario(nan_round=None, n_rounds=8)
+    run(ln, rounds)
+    ln.flush_faults()
+    assert ln.fault_stats["arrivals"] == 15  # 8 cohorts * 2 - padded slot
+    assert ln.applies_done >= 3
+    assert int(ln.state.weights_version) == ln.applies_done
+    # round_idx moved with every apply (no breach in this scenario)
+    assert int(ln.state.round_idx) == ln.applies_done
+
+
+def test_staleness_discount_changes_trajectory():
+    rounds = scenario(nan_round=None, n_rounds=12)
+    ln0 = faulted_learner(alpha=0.0)
+    run(ln0, rounds)
+    ln0.flush_faults()
+    ln5 = faulted_learner(alpha=0.5)
+    outs5 = run(ln5, rounds, keep_raw=("staleness_mean",))
+    ln5.flush_faults()
+    # same fault schedule both runs (same seed)
+    assert ln0.fault_stats == ln5.fault_stats
+    # stragglers + cross-cohort buffering produced genuinely stale
+    # contributions, so the discount must change the weights
+    assert any(o.get("staleness_mean", 0) > 0 for o in outs5)
+    assert not np.array_equal(np.asarray(ln0.state.weights),
+                              np.asarray(ln5.state.weights))
+
+
+def test_buffered_quarantine_under_faults():
+    rounds = scenario(nan_round=4, n_rounds=12)
+    ln = faulted_learner(client_quarantine=True, quarantine_rounds=2)
+    outs = run(ln, rounds)
+    ln.flush_faults()
+    assert not any(o["aborted"] for o in outs)
+    assert not bool(np.asarray(ln.state.aborted))
+    assert np.isfinite(np.asarray(ln.state.weights)).all()
+    ln2 = faulted_learner(client_quarantine=True, quarantine_rounds=2)
+    run(ln2, rounds)
+    ln2.flush_faults()
+    np.testing.assert_array_equal(np.asarray(ln.state.weights),
+                                  np.asarray(ln2.state.weights))
+
+
+def test_flush_faults_applies_partial_buffer():
+    # one cohort, M larger than anything that can arrive: only the final
+    # flush applies, and its bytes land in the learner totals
+    fm = FaultModel(0, N_CLIENTS, latency_sigma=1e-9)
+    ln = make_learner("buffered", fault_model=fm, buffer_m=5)
+    run(ln, scenario(nan_round=None, n_rounds=1))
+    assert ln.applies_done == 0
+    assert ln.total_upload_bytes == 0
+    out = ln.flush_faults()
+    assert ln.applies_done == 1
+    assert ln.fault_stats["partial_applies"] == 1
+    assert out["upload_bytes"] > 0
+    assert ln.total_upload_bytes == out["upload_bytes"]
+    # idempotent: nothing left in flight
+    assert ln.flush_faults() is None
+
+
+# ---------------------------------------------------------------------------
+# checkpointing: the in-flight buffer is transient by contract
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_excludes_buffer_and_roundtrips(tmp_path):
+    from commefficient_tpu.utils.checkpoint import (load_checkpoint,
+                                                    save_checkpoint)
+    fm = FaultModel(0, N_CLIENTS, latency_sigma=1e-9)
+    ln = make_learner("buffered", fault_model=fm, buffer_m=5)
+    run(ln, scenario(nan_round=None, n_rounds=2))
+    assert ln._buf_count > 0 or ln._events     # something in flight
+    fn = save_checkpoint(str(tmp_path), ln, "buf")
+    with np.load(fn) as z:
+        import json
+        paths = json.loads(str(z["leaf_paths"]))
+    assert not any(p.startswith(".buffer") for p in paths)
+    # buffered learner restores (current empty-or-filled buffer kept)
+    ln2 = make_learner("buffered", fault_model=None, buffer_m=5)
+    load_checkpoint(fn, ln2)
+    np.testing.assert_array_equal(np.asarray(ln.state.weights),
+                                  np.asarray(ln2.state.weights))
+    # and a SYNC learner can load a buffered checkpoint (no buffer leaves)
+    ln3 = make_learner("sync")
+    load_checkpoint(fn, ln3)
+    np.testing.assert_array_equal(np.asarray(ln.state.weights),
+                                  np.asarray(ln3.state.weights))
+
+
+# ---------------------------------------------------------------------------
+# sticky abort inside a ScanWindow (satellite: docs/README contract)
+# ---------------------------------------------------------------------------
+
+def test_scan_window_sticky_abort_freezes_state():
+    # per-round reference, stopped right after the breach latches
+    ln_ref = make_learner("sync")
+    rounds = scenario(nan_round=3)
+    run(ln_ref, rounds[:5])     # breach at round 3, one frozen round after
+    frozen_w = np.asarray(ln_ref.state.weights)
+    frozen_idx = int(ln_ref.state.round_idx)
+
+    # scan path: all 8 rounds through 4-round windows; rounds 4..7 are
+    # in-scan no-ops AFTER the latched breach
+    ln = make_learner("sync")
+    window = ln.scan_window(4)
+    outs = []
+    for r, (ids, batch, mask) in enumerate(rounds):
+        outs.extend(window.push(ids, batch, mask, r) or [])
+    outs.extend(window.flush() or [])
+    assert len(outs) == len(rounds)
+    assert not outs[2]["aborted"] and outs[3]["aborted"]
+    # sticky: every round after the breach reports aborted and moves
+    # NOTHING — no bytes, no weight update, no round counter
+    for o in outs[4:]:
+        assert o["aborted"]
+        assert o["download_bytes"] == 0 and o["upload_bytes"] == 0
+        assert o["update_l2"] == 0
+    np.testing.assert_array_equal(np.asarray(ln.state.weights), frozen_w)
+    assert int(ln.state.round_idx) == frozen_idx
+    np.testing.assert_array_equal(np.asarray(ln.state.opt.Vvelocity),
+                                  np.asarray(ln_ref.state.opt.Vvelocity))
