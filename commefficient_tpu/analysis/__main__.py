@@ -24,7 +24,7 @@ def main(argv=None) -> int:
                     "retrace / dtype / prng)")
     parser.add_argument("--target", default="all",
                         choices=["round", "buffered", "gpt2", "attention",
-                                 "sketch", "all"])
+                                 "sketch", "decode", "all"])
     parser.add_argument("--no-retrace", action="store_true",
                         help="skip the (compile-heavy) retrace guards")
     parser.add_argument("--prng-lint", action="store_true",

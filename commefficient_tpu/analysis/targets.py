@@ -297,6 +297,115 @@ def attention_target(bwd: bool = True) -> AuditTarget:
 
 
 # --------------------------------------------------------------------------
+# KV-cached decode (serving path)
+# --------------------------------------------------------------------------
+
+def _decode_engine(batch=3):
+    from commefficient_tpu.models.gpt2 import GPT2Config, GPT2DoubleHeads
+    from commefficient_tpu.serving import DecodeEngine
+
+    S, V = 32, 300
+    cfg = GPT2Config.tiny(vocab_size=V)
+    model = GPT2DoubleHeads(cfg)
+    rng = np.random.RandomState(17)
+    ids = jnp.asarray(rng.randint(0, V, (1, 1, 8)).astype(np.int32))
+    params = model.init(jax.random.PRNGKey(0), ids, ids,
+                        jnp.zeros((1, 1), jnp.int32),
+                        train=False)["params"]
+    return DecodeEngine(model, params, eos_id=V - 1, max_len=S), S
+
+
+def decode_target(program: str = "step") -> AuditTarget:
+    """The serving path's decode programs (serving/decode.py).
+
+    ``step`` — one token for every row, sampling inside the program.
+    The retrace guard drives the jitted step with fresh token/position
+    VALUES each call and asserts the compile cache stays flat: token
+    generation never retraces.  ``generate`` — the whole-reply program
+    (prefill + lax.scan of the step), walked through the scan body.
+
+    Both bind T to the CACHE capacity S, so the footprint rule bans a
+    materialized (B, H, S, S) score tensor anywhere in the program —
+    the single-query decode attention is (B, H, 1, S), O(S) per token —
+    and the transfer rule proves no host callback hides inside the
+    token loop."""
+    engine, S = _decode_engine()
+    B = 3
+    cfg = engine.model.config
+    tok = jnp.asarray(np.full((B,), 5, np.int32))
+    typ = jnp.asarray(np.full((B,), 7, np.int32))
+    pos = jnp.asarray(np.array([3, 9, 1], np.int32))
+    rng0 = jax.random.PRNGKey(2)
+    done = jnp.zeros((B,), bool)
+
+    if program == "step":
+        def trace():
+            return jax.make_jaxpr(engine._step_raw)(
+                engine.params, engine.init_cache(B), tok, typ, pos,
+                rng0, done)
+
+        def retrace():
+            cache = engine.init_cache(B)
+            rs = np.random.RandomState(23)
+            state = {"cache": cache, "tok": tok, "pos": pos,
+                     "rng": rng0, "done": done}
+
+            def drive(i):
+                # fresh token/position values every call — the across-
+                # tokens axis the gate is about
+                out = engine.step(engine.params, state["cache"],
+                                  state["tok"], typ, state["pos"],
+                                  state["rng"], state["done"])
+                state["cache"], state["tok"], state["pos"], \
+                    state["rng"], state["done"] = out
+
+            return check_retrace(engine.step, None, repeats=3, warmup=1,
+                                 drive=drive)
+
+        return AuditTarget(
+            name="decode/step",
+            description="KV-cached decode step, sampling in-program "
+                        "(GPT2 tiny, cache S=32)",
+            trace=trace,
+            dims={"B": B, "H": cfg.n_head, "T": S},
+            rules=(FootprintRule(DEFAULT_PATTERNS), TransferRule()),
+            retrace=retrace)
+
+    P, max_new = 8, 6
+    rs = np.random.RandomState(19)
+
+    def _prompts(i):
+        ids = jnp.asarray(rs.randint(0, cfg.vocab_size - 1,
+                                     (B, P)).astype(np.int32))
+        types = jnp.asarray(np.full((B, P), 7, np.int32))
+        lengths = jnp.asarray(np.array([8, 5, 3], np.int32))
+        return (engine.params, ids, types, lengths,
+                jnp.asarray(np.full((B,), 7, np.int32)),
+                jax.random.PRNGKey(i))
+
+    def trace():
+        args = _prompts(0)
+        return jax.make_jaxpr(
+            lambda *a: engine._generate_raw(*a, max_new=max_new))(*args)
+
+    def retrace():
+        def drive(i):
+            engine.generate_tokens(*_prompts(i), max_new=max_new)
+
+        return check_retrace(engine.generate_tokens, None, repeats=3,
+                             warmup=1, drive=drive)
+
+    return AuditTarget(
+        name="decode/generate",
+        description="prefill + scanned decode loop, one dispatch per "
+                    "reply (GPT2 tiny, cache S=32)",
+        trace=trace,
+        dims={"B": B, "H": cfg.n_head, "T": S},
+        rules=(FootprintRule(DEFAULT_PATTERNS), TransferRule()),
+        retrace=retrace)
+
+
+# --------------------------------------------------------------------------
 # sketch ops
 # --------------------------------------------------------------------------
 
@@ -355,9 +464,11 @@ def build_targets(name: str) -> list:
         return [sketch_target()]
     if name == "buffered":
         return [buffered_target()]
+    if name == "decode":
+        return [decode_target("step"), decode_target("generate")]
     if name == "all":
         return (build_targets("round") + build_targets("buffered")
                 + build_targets("gpt2") + build_targets("attention")
-                + build_targets("sketch"))
+                + build_targets("sketch") + build_targets("decode"))
     raise ValueError(f"unknown audit target {name!r} "
-                     f"(round|buffered|gpt2|attention|sketch|all)")
+                     f"(round|buffered|gpt2|attention|sketch|decode|all)")

@@ -124,10 +124,10 @@ class CausalSelfAttention(nn.Module):
     attn_dropout: str = "auto"    # 'auto' | 'output' | 'kernel'
 
     @nn.compact
-    def __call__(self, x, train: bool):
+    def __call__(self, x, train: bool, cache=None, position=None):
         from commefficient_tpu.ops.attention import (
-            blockwise_attention, kernel_prob_dropout_eligible,
-            ring_attention)
+            blockwise_attention, decode_attention, full_attention,
+            kernel_prob_dropout_eligible, ring_attention)
         B, T, C = x.shape
         qkv = nn.Dense(3 * C, dtype=self.dtype,
                        kernel_init=nn.initializers.normal(0.02))(x)
@@ -138,7 +138,45 @@ class CausalSelfAttention(nn.Module):
             # post-construction assignment can bypass GPT2Config's check;
             # never silently fall through to full attention
             raise ValueError(f"unknown attn_impl {self.attn_impl!r}")
-        if self.attn_impl == "blockwise":
+        new_cache = None
+        if cache is not None:
+            # KV-cached inference (docs/SERVING.md). Two static programs,
+            # keyed on T so each gets its own compile:
+            #   T == 1  decode — write this token's k/v at the row's
+            #           position (one-hot select: positions differ per
+            #           row under continuous batching) and run one query
+            #           against the whole cache, O(S) not O(S^2);
+            #   T  > 1  prefill from position 0 — causal self-attention
+            #           within the prompt window (cache slots beyond it
+            #           hold pad-derived garbage, masked/overwritten
+            #           before they ever become attendable), k/v written
+            #           with one dynamic_update_slice.
+            if self.attn_impl == "ring":
+                raise ValueError("KV-cache decoding does not compose with "
+                                 "attn_impl='ring' (no shard_map at serve "
+                                 "time); serve with 'full' or 'blockwise'")
+            S = cache["k"].shape[1]
+            if T == 1:
+                p = jnp.minimum(position, S - 1)
+                hit = (jnp.arange(S)[None, :] == p[:, None])[..., None, None]
+                ck = jnp.where(hit, k.astype(cache["k"].dtype), cache["k"])
+                cv = jnp.where(hit, v.astype(cache["v"].dtype), cache["v"])
+                y = decode_attention(q, ck, cv, p)
+            else:
+                if T > S:
+                    raise ValueError(
+                        f"prefill length {T} exceeds cache capacity {S}")
+                ck = jax.lax.dynamic_update_slice_in_dim(
+                    cache["k"], k.astype(cache["k"].dtype), 0, axis=1)
+                cv = jax.lax.dynamic_update_slice_in_dim(
+                    cache["v"], v.astype(cache["v"].dtype), 0, axis=1)
+                if self.attn_impl == "blockwise":
+                    y = blockwise_attention(q, k, v, causal=True,
+                                            block_size=self.attn_block_size)
+                else:
+                    y = full_attention(q, k, v, causal=True)
+            new_cache = {"k": ck, "v": cv}
+        elif self.attn_impl == "blockwise":
             if self.attn_dropout not in ("auto", "output", "kernel"):
                 raise ValueError(
                     f"unknown attn_dropout {self.attn_dropout!r}")
@@ -198,8 +236,9 @@ class CausalSelfAttention(nn.Module):
         y = y.reshape(B, T, C)
         y = nn.Dense(C, dtype=self.dtype,
                      kernel_init=nn.initializers.normal(0.02))(y)
-        return FusedDropout(self.dropout, self.dropout_impl)(
+        y = FusedDropout(self.dropout, self.dropout_impl)(
             y, deterministic=not train)
+        return y if cache is None else (y, new_cache)
 
 
 class Block(nn.Module):
@@ -228,7 +267,7 @@ class Block(nn.Module):
                         kernel_init=nn.initializers.normal(0.02))(m)
 
     @nn.compact
-    def __call__(self, x, train: bool):
+    def __call__(self, x, train: bool, cache=None, position=None):
         # epsilon matches HF GPT-2 (1e-5) so imported pretrained weights
         # reproduce reference logits (models/gpt2_import.py)
         ln = lambda t: nn.LayerNorm(dtype=self.dtype, epsilon=1e-5)(t)
@@ -237,33 +276,68 @@ class Block(nn.Module):
                                    self.attn_block_size, self.seq_axis,
                                    self.dropout_impl,
                                    attn_dropout=self.attn_dropout)
+        new_cache = None
+
+        def _attn(h):
+            # same submodule either way, so the params tree is identical
+            # between training and cache-mode serving
+            nonlocal new_cache
+            if cache is None:
+                return attn(h, train)
+            out, new_cache = attn(h, train, cache=cache, position=position)
+            return out
+
         drop = lambda t: FusedDropout(self.dropout, self.dropout_impl,
                                       name="mlp_drop")(
             t, deterministic=not train)
         if self.post_ln:
             # GPT-1 (ref 'openai-gpt'): LN AFTER each residual add
-            x = ln(x + attn(x, train))
-            return ln(x + drop(self._mlp(x, train)))
-        h = ln(x)
-        x = x + attn(h, train)
-        h = ln(x)
-        return x + drop(self._mlp(h, train))
+            x = ln(x + _attn(x))
+            out = ln(x + drop(self._mlp(x, train)))
+        else:
+            h = ln(x)
+            x = x + _attn(h)
+            h = ln(x)
+            out = x + drop(self._mlp(h, train))
+        return out if cache is None else (out, new_cache)
 
 
 class GPT2DoubleHeads(nn.Module):
     """Returns (lm_logits (B,C,T,V), mc_logits (B,C)) — or, with
     ``config.fused_lm_head``, (hidden (B,C,T,E), mc_logits (B,C)) for the
-    vocab-chunked fused head+CE in the losses module."""
+    vocab-chunked fused head+CE in the losses module.
+
+    KV-cached inference: pass ``cache`` (init_decode_cache pytree),
+    ``position`` and optionally ``logits_at`` with ``train=False`` to get
+    (lm_logits (B*C, V), mc_logits, new_cache) — T>1 prefills the cache,
+    T==1 decodes one token per row against it (docs/SERVING.md). Cache
+    mode always materializes the per-position logits it returns, so
+    ``fused_lm_head`` is irrelevant to it."""
     config: GPT2Config
 
     @nn.compact
     def __call__(self, input_ids, token_type_ids, mc_token_ids,
-                 train: bool = True):
+                 train: bool = True, cache=None, position=None,
+                 logits_at=None):
         cfg = self.config
         if cfg.fused_lm_head and cfg.attn_impl == "ring":
             raise ValueError("fused_lm_head is not supported with "
                              "attn_impl='ring' (the seq-parallel losses "
                              "own their logits handling)")
+        if cache is not None:
+            # KV-cached inference: ``cache`` is the pytree from
+            # init_decode_cache, ``position`` (B*C,) each row's write
+            # offset (0 for prefill), ``logits_at`` (B*C,) the per-row
+            # index to read LM logits at (default T-1). Returns
+            # (lm_logits (B*C, V), mc_logits, new_cache) — logits ONLY
+            # at the sampled position, so the (B, T, V) tensor never
+            # materializes on the serving path.
+            if train:
+                raise ValueError("cache decoding is inference-only; "
+                                 "call with train=False")
+            if cfg.moe_experts > 0:
+                raise ValueError("KV-cache decoding does not support MoE "
+                                 "blocks yet (capacity routing at T=1)")
         B, C, T = input_ids.shape
         ids = input_ids.reshape(B * C, T)
         types = token_type_ids.reshape(B * C, T)
@@ -280,25 +354,43 @@ class GPT2DoubleHeads(nn.Module):
             # inside shard_map T is the LOCAL sequence shard; positions
             # (and the MC-head pick below) must be global
             pos = pos + jax.lax.axis_index(cfg.seq_axis) * T
+        elif cache is not None:
+            pos = position[:, None] + pos      # per-row decode offsets
         x = wte(ids) + wpe(pos) + wte(types)
         x = FusedDropout(cfg.dropout, cfg.dropout_impl)(
             x, deterministic=not train)
-        # static_argnums counts the flax scope as arg 0: train is arg 2
+        # static_argnums counts the flax scope as arg 0: train is arg 2.
+        # Cache mode always uses the plain Block (remat buys nothing at
+        # inference); lifted transforms preserve param names, so the same
+        # checkpoint serves either way.
         block_cls = (nn.remat(Block, static_argnums=(2,))
-                     if cfg.remat else Block)
+                     if cfg.remat and cache is None else Block)
         post_ln = cfg.arch == "openai-gpt"
-        for _ in range(cfg.n_layer):
-            x = block_cls(cfg.n_head, cfg.dropout, cfg.jnp_dtype,
-                          cfg.attn_impl, cfg.attn_block_size,
-                          cfg.seq_axis, cfg.moe_experts,
-                          cfg.moe_capacity_factor, post_ln,
-                          cfg.dropout_impl,
-                          getattr(cfg, "attn_dropout", "auto"))(x, train)
+        new_cache = []
+        for i in range(cfg.n_layer):
+            blk = block_cls(cfg.n_head, cfg.dropout, cfg.jnp_dtype,
+                            cfg.attn_impl, cfg.attn_block_size,
+                            cfg.seq_axis, cfg.moe_experts,
+                            cfg.moe_capacity_factor, post_ln,
+                            cfg.dropout_impl,
+                            getattr(cfg, "attn_dropout", "auto"))
+            if cache is None:
+                x = blk(x, train)
+            else:
+                x, layer_cache = blk(x, train, cache=cache[i],
+                                     position=position)
+                new_cache.append(layer_cache)
         x = x.astype(jnp.float32)
         if not post_ln:
             x = nn.LayerNorm(epsilon=1e-5)(x)   # GPT-1 has no final LN
 
-        if cfg.fused_lm_head:
+        if cache is not None:
+            # LM logits only at the sampled positions (tied wte head,
+            # f32): (B*C, V), never (B*C, T, V)
+            idx = (jnp.full((B * C,), T - 1, jnp.int32)
+                   if logits_at is None else logits_at)
+            lm_out = wte.attend(x[jnp.arange(B * C), idx])
+        elif cfg.fused_lm_head:
             # the loss applies the vocab-chunked fused head+CE
             # (ops/fused_ce.py) to these hidden states with the tied wte
             # weight it reads from params — the (N, V) logits tensor is
@@ -336,4 +428,22 @@ class GPT2DoubleHeads(nn.Module):
         mc = nn.Dense(1, kernel_init=nn.initializers.normal(0.02),
                       name="mc_head")(picked)
         mc_logits = mc.reshape(B, C)
+        if cache is not None:
+            return lm_out, mc_logits, tuple(new_cache)
         return lm_out, mc_logits
+
+
+def init_decode_cache(config: GPT2Config, batch_size: int, max_len: int):
+    """Zero KV cache for ``GPT2DoubleHeads`` cache-mode inference: a tuple
+    with one ``{"k", "v"}`` dict per layer, each (batch, max_len, n_head,
+    head_dim) in the model's compute dtype. ``max_len`` is the cache
+    capacity — prompt plus generated tokens — and is bounded by the
+    position-embedding table."""
+    if max_len > config.n_positions:
+        raise ValueError(f"cache capacity {max_len} exceeds n_positions "
+                         f"{config.n_positions}")
+    head_dim = config.n_embd // config.n_head
+    shape = (batch_size, max_len, config.n_head, head_dim)
+    return tuple({"k": jnp.zeros(shape, config.jnp_dtype),
+                  "v": jnp.zeros(shape, config.jnp_dtype)}
+                 for _ in range(config.n_layer))

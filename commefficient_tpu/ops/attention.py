@@ -30,6 +30,11 @@ The scan and ring formulations still do not compose with prob-dropout
 O(T^2)); callers that need dropout off-kernel apply output dropout
 instead (models/gpt2.py's fallback).
 
+* ``decode_attention`` — the inference mode: one (or a few) query rows
+  against a cached (B, S, H, D) key/value array with per-row global
+  positions. O(S) per generated token; the KV-cached serving path
+  (models/gpt2.py cache mode, commefficient_tpu/serving/) is built on it.
+
 Layout: q/k/v are (B, T, H, D); causal masking uses GLOBAL positions, so
 shards mask correctly wherever they sit in the ring. ``kv_mask`` (B, T)
 marks valid (non-pad) keys.
@@ -89,6 +94,36 @@ def full_attention(q, k, v, *, causal: bool = True,
     # online-softmax impls use
     any_valid = jnp.any(s > _NEG / 2, axis=-1)            # (B, H, Tq)
     return jnp.where(any_valid.transpose(0, 2, 1)[..., None], out, 0.0)
+
+
+def decode_attention(q, k, v, q_pos, *,
+                     kv_mask: Optional[jax.Array] = None) -> jax.Array:
+    """Single-query attention against a KV cache: the decode mode.
+
+    ``q`` is (B, Tq, H, D) with a SMALL static Tq (1 for token-by-token
+    decode); ``k``/``v`` are the cache, (B, S, H, D) with S the cache
+    capacity. ``q_pos`` (B,) is each row's global position of q's first
+    query, so scores are (B, H, Tq, S) — O(S) work and memory per token
+    instead of the O(S^2) a full recompute pays — and key position kp is
+    attended iff kp <= q_pos[b] + t. Stale cache slots beyond the row's
+    position are masked out by construction, so callers may leave
+    garbage (pad-derived prefill writes) above the write position.
+
+    Every query attends at least to its own just-written position, so
+    no fully-masked rows exist and no zero-emission correction is
+    needed. f32 scores via MXU accumulation (see full_attention)."""
+    B, Tq, H, D = q.shape
+    S = k.shape[1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) / np.sqrt(D)
+    kp = jnp.arange(S)
+    qp = q_pos[:, None] + jnp.arange(Tq)[None, :]          # (B, Tq)
+    mask = kp[None, None, :] <= qp[:, :, None]             # (B, Tq, S)
+    if kv_mask is not None:
+        mask = mask & kv_mask[:, None, :]
+    s = s + jnp.where(mask, 0.0, _NEG)[:, None]            # broadcast H
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
 def _fold_block(acc, q, kb, vb, q_pos, k_pos, kv_mask_b, causal):

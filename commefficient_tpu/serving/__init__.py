@@ -1,0 +1,14 @@
+"""Serving path: KV-cached jitted decode + continuous batching.
+
+``DecodeEngine`` owns the three compiled programs (prefill, one decode
+step for every row, and a whole-reply ``lax.scan`` generate);
+``ContinuousBatchingServer`` drives the step program over a fixed slot
+array, admitting and retiring requests between jitted steps. See
+docs/SERVING.md for the cache layout, the slot lifecycle, and the
+invariants the ``decode`` graft-audit target enforces.
+"""
+
+from commefficient_tpu.serving.decode import DecodeEngine
+from commefficient_tpu.serving.server import ContinuousBatchingServer
+
+__all__ = ["DecodeEngine", "ContinuousBatchingServer"]

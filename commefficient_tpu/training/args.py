@@ -12,6 +12,12 @@ import argparse
 from commefficient_tpu.config import DP_MODES, ERROR_TYPES, MODES, FedConfig
 from commefficient_tpu.models import MODEL_REGISTRY
 
+# --fused_ce auto threshold: at T >= this the (B*C*T, vocab) logits tensor
+# is the batch's dominant activation and the chunked fused head wins on
+# both HBM and (slightly) time; below it the materialized XLA path is
+# faster (docs/ROOFLINE.md A/B at T=256 vs T=512)
+FUSED_CE_AUTO_T = 512
+
 
 def build_parser(default_lr: float = 0.4) -> argparse.ArgumentParser:
     p = argparse.ArgumentParser()
@@ -133,13 +139,22 @@ def build_parser(default_lr: float = 0.4) -> argparse.ArgumentParser:
                         "pre-kernel output-dropout behavior; 'kernel' "
                         "requires the in-kernel path and errors when "
                         "ineligible (bench/A-B use)")
-    p.add_argument("--fused_lm_head", action="store_true",
-                   help="compute the GPT2 LM loss with the vocab-chunked "
-                        "fused head+CE (ops/fused_ce.py): the (tokens, "
-                        "vocab) logits tensor never materializes — a "
-                        "memory lever for long sequences (measured "
+    p.add_argument("--fused_ce", choices=("auto", "on", "off"),
+                   default="auto",
+                   help="vocab-chunked fused LM-head CE (ops/fused_ce.py): "
+                        "the (tokens, vocab) logits tensor never "
+                        "materializes. 'auto' (default) turns it on at "
+                        f"--max_seq_len >= {FUSED_CE_AUTO_T} — where that "
+                        "tensor starts to dominate HBM and the chunked "
+                        "path wins — and leaves it off below (measured "
                         "slightly SLOWER than XLA's fused materialized "
-                        "path at T=256, docs/ROOFLINE.md)")
+                        "path at T=256, docs/ROOFLINE.md); auto also "
+                        "stays off under ring attention and seq=/stage= "
+                        "meshes, where the fused path is not plumbed. "
+                        "'on'/'off' force the choice ('on' under those "
+                        "meshes still fails loudly downstream)")
+    p.add_argument("--fused_lm_head", action="store_true",
+                   help="legacy alias for --fused_ce on")
     p.add_argument("--transfer_guard", choices=("allow", "log", "disallow"),
                    default="disallow",
                    help="jax.transfer_guard mode applied around every "
@@ -209,6 +224,33 @@ def args_to_config(args, **overrides) -> FedConfig:
     kwargs = {k: v for k, v in vars(args).items() if k in fields}
     kwargs.update(overrides)
     return FedConfig(**kwargs)
+
+
+def resolve_fused_ce(args, mesh=None) -> bool:
+    """``--fused_ce`` (+ legacy ``--fused_lm_head``) -> fused_lm_head bool.
+
+    'on'/'off' are explicit. 'auto' enables the vocab-chunked fused
+    head+CE exactly when it pays: ``max_seq_len >= FUSED_CE_AUTO_T`` on a
+    plain forward. Under ring attention or a seq=/stage= mesh, auto
+    resolves to off — the fused path is not plumbed there (models/gpt2.py
+    rejects ring; the GPipe loss materializes its own head einsum) — while
+    an explicit 'on' is passed through so those paths keep failing loudly
+    instead of silently downgrading an explicit request."""
+    choice = getattr(args, "fused_ce", "auto")
+    if getattr(args, "fused_lm_head", False):
+        if choice == "off":
+            raise ValueError("--fused_lm_head (legacy alias for "
+                             "--fused_ce on) conflicts with --fused_ce off")
+        choice = "on"
+    if choice != "auto":
+        return choice == "on"
+    if getattr(args, "attn_impl", "full") == "ring":
+        return False
+    if mesh is not None:
+        for axis in ("seq", "stage"):
+            if axis in mesh.axis_names and mesh.shape[axis] > 1:
+                return False
+    return int(getattr(args, "max_seq_len", 0)) >= FUSED_CE_AUTO_T
 
 
 def make_fault_model(args, num_clients: int):

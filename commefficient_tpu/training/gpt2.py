@@ -27,7 +27,8 @@ from commefficient_tpu.federated.api import FedLearner
 from commefficient_tpu.federated.losses import (make_gpt2_train_loss,
                                                 make_gpt2_val_loss)
 from commefficient_tpu.models.gpt2 import GPT2Config, GPT2DoubleHeads
-from commefficient_tpu.training.args import args_to_config, build_parser
+from commefficient_tpu.training.args import (args_to_config, build_parser,
+                                             resolve_fused_ce)
 from commefficient_tpu.utils.logging import TableLogger, Timer
 from commefficient_tpu.utils.schedules import gpt2_lr_schedule
 
@@ -94,7 +95,9 @@ def train(args, mesh=None, max_rounds=None, log=True):
     # dropout when eligible ('auto'), forced output dropout, or
     # loud-failure 'kernel' (see args.py help / models/gpt2.py)
     gcfg.attn_dropout = getattr(args, "attn_dropout", "auto")
-    gcfg.fused_lm_head = bool(getattr(args, "fused_lm_head", False))
+    # fused LM-head CE: --fused_ce auto|on|off resolved against seq len
+    # and mesh (args.resolve_fused_ce); legacy --fused_lm_head forces on
+    gcfg.fused_lm_head = resolve_fused_ce(args, mesh)
     gcfg.moe_experts = int(getattr(args, "moe_experts", 0) or 0)
     gcfg.moe_capacity_factor = float(getattr(args, "moe_capacity_factor",
                                              1.25))
@@ -190,9 +193,9 @@ def train(args, mesh=None, max_rounds=None, log=True):
         # mesh, and seq/stage are mutually exclusive inner axes)
         if gcfg.fused_lm_head:
             raise ValueError(
-                "--fused_lm_head is not plumbed through the GPipe loss "
+                "--fused_ce on is not plumbed through the GPipe loss "
                 "(make_gpt2_train_loss_pp materializes logits via its own "
-                "head einsum); drop the flag for --mesh stage=S")
+                "head einsum); use --fused_ce auto/off for --mesh stage=S")
         if gcfg.dropout_impl != "xla":
             raise ValueError(
                 "--dropout_impl {} is not plumbed through the pipeline's "

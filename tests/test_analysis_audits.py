@@ -78,6 +78,28 @@ def test_sketch_audit_passes(audited):
     assert rep.ok, rep.format()
 
 
+def test_decode_step_audit_passes_with_zero_retrace(audited):
+    """The serving decode step: no (B, H, T, T) aval (single-query
+    attention is (B, H, 1, S)), no host callbacks inside the jit, and the
+    compile cache stays at one entry while the step is driven with
+    evolving cache/position/done state — the continuous-batching server's
+    core invariant."""
+    rep = audited("decode", 0, with_retrace=True)
+    assert rep.target == "decode/step"
+    assert rep.ok, rep.format()
+
+
+def test_decode_generate_audit_passes_and_visits_scan(audited):
+    """The fully-jitted generate program (prefill + lax.scan of decode
+    steps with in-loop sampling): the audit descends into the scan body
+    and finds no quadratic aval, no transfer, no retrace across prompts
+    of different content (same shapes)."""
+    rep = audited("decode", 1, with_retrace=True)
+    assert rep.target == "decode/generate"
+    assert rep.ok, rep.format()
+    assert rep.stats.visited("scan"), rep.stats.descended_into
+
+
 def test_transfer_guard_active_in_suite():
     """conftest.py arms jax.transfer_guard('disallow') around every
     round dispatch for the whole test session."""

@@ -34,13 +34,16 @@ def test_registry_covers_every_row():
     a row cannot exist in one mode and be silently skipped by the
     other."""
     names = [n for n, _ in bench._bench_rows()]
-    assert len(names) == len(set(names)) == 11
+    assert len(names) == len(set(names)) == 14
     for must in ("cifar10_resnet9_fed_rounds_per_sec",
                  "gpt2_personachat_tokens_per_sec_chip_flash_attn",
                  "flash_attn_t256_parity_dropout_kernel_ab",
                  "gpt2_longcontext_4k_blockwise_tokens_per_sec_chip",
                  "offload_gather_scatter_overlap",
-                 "buffered_fedbuff_round_overhead"):
+                 "buffered_fedbuff_round_overhead",
+                 "gpt2_decode_tokens_per_sec_chip_b1",
+                 "gpt2_decode_tokens_per_sec_chip_b8",
+                 "gpt2_decode_tokens_per_sec_chip_b64"):
         assert must in names
 
 
@@ -62,6 +65,15 @@ def test_flash_ab_row_traces_every_config(dry):
 def test_offload_row_traces_the_offload_round_signature(dry):
     out = bench.bench_offload_overlap()
     assert out["dry_run"] == "ok"
+
+
+def test_decode_row_traces_prefill_generate_and_ab(dry):
+    """The gpt2-small KV-cached decode row: prefill, the jitted generate
+    scan, and the uncached A/B incumbent all trace via eval_shape with no
+    compile — the serving path's signature drift gate."""
+    status, breakdown = bench.bench_generate(batch=1, ab_uncached=True)
+    assert status["dry_run"] == "ok"
+    assert breakdown == {}
 
 
 def test_cli_dry_run_filters_rows_and_exits_zero(monkeypatch, capsys):

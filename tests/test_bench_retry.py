@@ -119,6 +119,13 @@ def test_main_emits_json_and_exits_zero_despite_failed_metrics(
                        "xla_full_prob_dropout_ms": 10.4,
                        "best_flash_dropout_ms": 8.0}))
 
+    monkeypatch.setattr(
+        bench, "bench_generate",
+        lambda batch=8, prompt_len=128, new_tokens=64, ab_uncached=False:
+        (5000.0 * batch, {"batch": batch, "prefill_ms": 3.0,
+                          "decode_per_token_ms": 0.2,
+                          "decode_flat_in_prefix_ratio": 1.0}))
+
     def dead(*a, **k):
         raise RuntimeError("UNAVAILABLE: tunnel read body")
 
@@ -136,6 +143,7 @@ def test_main_emits_json_and_exits_zero_despite_failed_metrics(
     assert out["breakdown_ms"]["offload_gather_scatter_overlap_ms"] == 20.0
     metrics = {e["metric"] for e in out["extra_metrics"]}
     assert "gpt2_personachat_tokens_per_sec_chip" in metrics
+    assert "gpt2_decode_tokens_per_sec_chip_b64" in metrics
     # the dead metrics are absent from the numbers but present in errors
     assert "gpt2_fetchsgd_sketch_rounds_per_sec" not in metrics
     failed = {e["metric"] for e in out["errors"]}

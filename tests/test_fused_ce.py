@@ -138,6 +138,77 @@ def test_fused_lm_head_rejects_ring():
         m.init(jax.random.PRNGKey(0), ids, types, mc, train=False)
 
 
+def _flag_args(**kw):
+    from types import SimpleNamespace
+    base = dict(fused_ce="auto", fused_lm_head=False, attn_impl="full",
+                max_seq_len=256)
+    base.update(kw)
+    return SimpleNamespace(**base)
+
+
+def _fake_mesh(**axes):
+    from types import SimpleNamespace
+    return SimpleNamespace(axis_names=tuple(axes), shape=dict(axes))
+
+
+def test_fused_ce_auto_dispatches_on_seq_len():
+    """--fused_ce auto: off below the T=512 threshold, on at/above it —
+    the flip point where the (tokens, vocab) logits tensor starts to
+    dominate HBM (docs/ROOFLINE.md)."""
+    from commefficient_tpu.training.args import (FUSED_CE_AUTO_T,
+                                                 resolve_fused_ce)
+
+    assert not resolve_fused_ce(_flag_args(max_seq_len=256))
+    assert not resolve_fused_ce(_flag_args(max_seq_len=FUSED_CE_AUTO_T - 1))
+    assert resolve_fused_ce(_flag_args(max_seq_len=FUSED_CE_AUTO_T))
+    assert resolve_fused_ce(_flag_args(max_seq_len=1024))
+
+
+def test_fused_ce_explicit_overrides_auto():
+    from commefficient_tpu.training.args import resolve_fused_ce
+
+    assert resolve_fused_ce(_flag_args(fused_ce="on", max_seq_len=64))
+    assert not resolve_fused_ce(_flag_args(fused_ce="off",
+                                           max_seq_len=2048))
+    # legacy --fused_lm_head == --fused_ce on; combining it with an
+    # explicit off is a contradiction, not a silent pick
+    assert resolve_fused_ce(_flag_args(fused_lm_head=True, max_seq_len=64))
+    with pytest.raises(ValueError, match="fused_lm_head"):
+        resolve_fused_ce(_flag_args(fused_ce="off", fused_lm_head=True))
+
+
+def test_fused_ce_auto_stays_off_where_not_plumbed():
+    """auto must never resolve to on under ring attention or seq=/stage=
+    meshes (the model/pipeline would reject it); explicit 'on' passes
+    through so those rejections stay loud."""
+    from commefficient_tpu.training.args import resolve_fused_ce
+
+    long = dict(max_seq_len=2048)
+    assert not resolve_fused_ce(_flag_args(attn_impl="ring", **long))
+    assert not resolve_fused_ce(_flag_args(**long),
+                                _fake_mesh(clients=1, seq=2))
+    assert not resolve_fused_ce(_flag_args(**long),
+                                _fake_mesh(clients=1, stage=2))
+    # size-1 inner axes are a plain data mesh: auto still applies
+    assert resolve_fused_ce(_flag_args(**long),
+                            _fake_mesh(clients=4, seq=1))
+    assert resolve_fused_ce(_flag_args(fused_ce="on", attn_impl="ring",
+                                       **long))
+
+
+def test_fused_ce_parser_default_and_legacy_alias():
+    from commefficient_tpu.training.args import (build_parser,
+                                                 resolve_fused_ce)
+
+    args = build_parser().parse_args([])
+    assert args.fused_ce == "auto" and not args.fused_lm_head
+    args.max_seq_len, args.attn_impl = 256, "full"
+    assert not resolve_fused_ce(args)
+    args = build_parser().parse_args(["--fused_lm_head"])
+    args.max_seq_len, args.attn_impl = 256, "full"
+    assert resolve_fused_ce(args)
+
+
 def test_tpu_bits_falls_back_to_xla_off_tpu():
     """On CPU the 'tpu_bits' impl must route to masked_dropout and match
     it bit-for-bit (same key, same bits)."""
