@@ -197,7 +197,7 @@ def bench_cifar_sketch(approx_recall=0.95):
 
 def _gpt2_fed_setup(B=8, attn_impl="full", dropout_impl="xla_rbg",
                     fused_lm_head=False, T=256, attn_dropout="auto",
-                    **cfg_kw):
+                    attn_block_size=None, **cfg_kw):
     """Shared gpt2-small federated-bench setup: model, learner, and a
     device-resident synthetic PersonaChat batch (W=4, B dialogs, C=2,
     T tokens — 16k tokens/round at the default B=8/T=256, a realistic
@@ -221,7 +221,11 @@ def _gpt2_fed_setup(B=8, attn_impl="full", dropout_impl="xla_rbg",
     gcfg.dropout = 0.1
     gcfg.dtype = "bfloat16"  # MXU-native compute; params stay f32
     gcfg.attn_impl = attn_impl
-    gcfg.attn_block_size = min(256, T)
+    # default block pick: 256 tiles. The T=512 federated row keeps 256
+    # explicitly — flash_attn_t512_parity_dropout_kernel_ab sweeps the
+    # candidates (up to 512x512 single-tile) and the pick below should
+    # track whatever that row crowns on-chip.
+    gcfg.attn_block_size = attn_block_size or min(256, T)
     gcfg.attn_dropout = attn_dropout
     if DRY_RUN and attn_dropout == "kernel" \
             and jax.default_backend() != "tpu":
@@ -346,7 +350,7 @@ def bench_gpt2_tokens(attn_impl="full", B=8, T=256, attn_dropout="auto",
     return scanned, pd
 
 
-def bench_flash_dropout_kernel_ab(T=256, rate=0.1):
+def bench_flash_dropout_kernel_ab(T=256, rate=0.1, blocks=None):
     """Kernel-level A/B at the federated bench's attention shape: fused
     flash attention WITH in-kernel parity dropout (block-size sweep — the
     kernel's DEFAULT_BLOCK_Q=2048 was tuned at T=4096 and clamps to one
@@ -357,6 +361,12 @@ def bench_flash_dropout_kernel_ab(T=256, rate=0.1):
     dispatches per sync). This adjudicates the tentpole at the op level
     even if the round-level number moves for unrelated reasons, and is
     the measured basis for docs/ROOFLINE.md's dropout-kernel section.
+
+    ``blocks`` overrides the (block_q, block_k) sweep; the T=512 row
+    passes candidates up to the single-tile 512x512 so the federated
+    T=512 flash row's ``attn_block_size`` pick (_gpt2_fed_setup) is
+    re-tuned from measurements rather than inherited from the T=256
+    sweep.
 
     Returns (xla_ms / best_flash_ms speedup, per-config ms dict)."""
     import jax
@@ -407,7 +417,8 @@ def bench_flash_dropout_kernel_ab(T=256, rate=0.1):
         return jnp.einsum("bhqk,bkhd->bqhd", att, v)
 
     results = {}
-    for bq, bk in ((256, 256), (256, 128), (128, 256), (128, 128)):
+    for bq, bk in blocks or ((256, 256), (256, 128), (128, 256),
+                             (128, 128)):
         t = timed_fwd_bwd(
             lambda q, k, v, bq=bq, bk=bk: flash_attention(
                 q, k, v, block_q=bq, block_k=bk, dropout_rate=rate,
@@ -451,6 +462,105 @@ def bench_gpt2_sketch_rounds(approx_recall=0.95, per_dispatch=True):
     if not per_dispatch:   # skip the extra compile + 3x6 timed rounds
         return scanned, None
     return scanned, 1.0 / _timed_windows(learner, one_round, n_rounds=6)
+
+
+def bench_gpt2_bucketed_rounds(T=256, Ks=(1, 4, 16)):
+    """Bucketed transmit A/B (``--grad_buckets``, docs/ROOFLINE.md
+    Round 7): the gpt2-small FetchSGD sketch round with the transmit
+    split into K layer-grouped, 128-lane-aligned buckets — each bucket's
+    sketch (and, on a mesh, its psum) is an independent op XLA's
+    latency-hiding scheduler can overlap with the rest of the backward —
+    priced against the K=1 monolithic incumbent.
+
+    ONE model/learner setup per row; only the round program is rebuilt
+    per K from the learner's stashed loss/unflatten/mask (the exact
+    production constructor path: ``dataclasses.replace(cfg,
+    grad_buckets=K)`` + ``make_grad_buckets`` + ``build_round_step``),
+    so the A/B isolates the transmit restructuring. Every K is timed
+    with the same window convention; K=1 is trajectory-identical to the
+    pre-bucketing round (tests/test_grad_buckets.py), so its number IS
+    the incumbent's. A K whose realized plan collapses (num_buckets <
+    requested) is still reported, labeled with the realized count.
+
+    Returns (K=1 ms / best-K ms speedup — may be < 1, the refutation
+    outcome ROOFLINE.md Round 7 budgets for — and the per-K ms dict)."""
+    import dataclasses
+
+    from commefficient_tpu.federated.round import build_round_step
+    from commefficient_tpu.federated.state import make_grad_buckets
+    from commefficient_tpu.ops.countsketch import LANES
+
+    learner, one_round, _, (batch, mask, ids_fn) = _gpt2_fed_setup(
+        B=4, T=T, attn_impl="blockwise", attn_dropout="kernel",
+        mode="sketch", error_type="virtual", k=50_000, num_rows=5,
+        num_cols=500_000, topk_approx_recall=0.95)
+
+    results = {}
+    try:
+        for K in Ks:
+            cfg_k = dataclasses.replace(learner.cfg, grad_buckets=K)
+            plan = make_grad_buckets(learner._param_leaf_sizes,
+                                     cfg_k.grad_dim, K, align=LANES)
+            learner._round = build_round_step(
+                learner._loss_train, learner._round_unflatten, cfg_k,
+                mesh=learner.mesh,
+                trainable_mask=learner._trainable_mask, buckets=plan)
+            realized = plan.num_buckets if plan is not None else 1
+            name = f"bucketed_K{K}_ms"
+            if realized != K:
+                name = f"bucketed_K{K}_realized{realized}_ms"
+            if DRY_RUN:
+                _dry_trace_round(learner, ids_fn, batch, mask)
+                results[name] = float("nan")
+                continue
+            results[name] = round(
+                _timed_windows(learner, one_round, n_rounds=6) * 1e3, 1)
+    finally:
+        # the learner dies with this row, but keep the invariant anyway:
+        # _round always matches learner.cfg/grad_buckets on exit
+        learner._round = build_round_step(
+            learner._loss_train, learner._round_unflatten, learner.cfg,
+            mesh=learner.mesh, trainable_mask=learner._trainable_mask,
+            buckets=learner.grad_buckets)
+    if DRY_RUN:
+        return {"dry_run": "ok", "configs": len(results)}, results
+    base = results["bucketed_K1_ms"]
+    best = min(v for k, v in results.items() if not k.startswith(
+        "bucketed_K1"))
+    return round(base / best, 4), results
+
+
+def bench_gpt2_fused_ce_ab(T=512):
+    """--fused_ce A/B at T=512 (ROADMAP 4c): the double-heads LM loss
+    with the head matmul + cross-entropy fused (ops/fused_ce.py — logits
+    never materialized, O(B*T*block) memory) vs the incumbent
+    materialized-(B,C,T,V)-logits CE, both inside the full federated
+    round at the long-context shape where the (B,C,T,V) f32 logits cost
+    real HBM (B=4, C=2, T=512, V=50262: ~825 MB). At T=256 the fused
+    path measured ~12 ms SLOWER (it is a memory lever, not a speed
+    lever — _gpt2_fed_setup note); this row prices the T=512 crossover
+    so ``--fused_ce auto`` has a measured basis.
+
+    Returns (fused tokens/s / materialized tokens/s — > 1 means fused
+    wins at this shape — and the per-variant tokens/s dict)."""
+    results = {}
+    for label, fused in (("materialized_logits", False), ("fused_ce", True)):
+        learner, one_round, tokens_per_round, (batch, mask, ids_fn) = \
+            _gpt2_fed_setup(B=4, T=T, attn_impl="blockwise",
+                            attn_dropout="kernel", fused_lm_head=fused,
+                            mode="uncompressed", error_type="none")
+        if DRY_RUN:
+            _dry_trace_round(learner, ids_fn, batch, mask)
+            results[f"{label}_tokens_per_sec"] = float("nan")
+            continue
+        results[f"{label}_tokens_per_sec"] = round(
+            tokens_per_round / _timed_scan_windows(learner, ids_fn, batch,
+                                                   mask), 1)
+    if DRY_RUN:
+        return {"dry_run": "ok", "configs": len(results)}, results
+    ratio = (results["fused_ce_tokens_per_sec"]
+             / results["materialized_logits_tokens_per_sec"])
+    return round(ratio, 4), results
 
 
 def bench_longcontext_tokens():
@@ -909,8 +1019,18 @@ def _bench_rows():
                                    per_dispatch=False)),
         ("flash_attn_t256_parity_dropout_kernel_ab",
          lambda: bench_flash_dropout_kernel_ab()),
+        ("flash_attn_t512_parity_dropout_kernel_ab",
+         lambda: bench_flash_dropout_kernel_ab(
+             T=512, blocks=((512, 512), (512, 256), (256, 512),
+                            (256, 256), (256, 128), (128, 128)))),
+        ("gpt2_fused_ce_t512_ab",
+         lambda: bench_gpt2_fused_ce_ab(T=512)),
         ("gpt2_fetchsgd_sketch_rounds_per_sec",
          lambda: bench_gpt2_sketch_rounds()),
+        ("gpt2_fetchsgd_bucketed_rounds_t256_ab",
+         lambda: bench_gpt2_bucketed_rounds(T=256)),
+        ("gpt2_fetchsgd_bucketed_rounds_t512_ab",
+         lambda: bench_gpt2_bucketed_rounds(T=512)),
         ("gpt2_fetchsgd_sketch_rounds_per_sec_exact_topk",
          lambda: bench_gpt2_sketch_rounds(approx_recall=0.0,
                                           per_dispatch=False)),
@@ -933,13 +1053,23 @@ def _dry_run_main(row_filter=""):
     """``--dry-run``: build every (selected) row's real setup and trace
     its jitted programs without compiling or timing. Prints one status
     line per row; returns the number of rows that failed to trace."""
+    import fnmatch
     global DRY_RUN
     DRY_RUN = True
     sel = [s for s in row_filter.split(",") if s]
+
+    def matches(name, s):
+        # glob selectors ('*bucket*') when the pattern asks for them,
+        # plain substring match otherwise — so both CI's quoted globs
+        # and bare 'decode' keep working
+        if any(ch in s for ch in "*?["):
+            return fnmatch.fnmatch(name, s)
+        return s in name
+
     failed = 0
     try:
         for name, fn in _bench_rows():
-            if sel and not any(s in name for s in sel):
+            if sel and not any(matches(name, s) for s in sel):
                 continue
             t0 = time.perf_counter()
             try:
@@ -988,7 +1118,11 @@ def main():
     gpt2_flash = res["gpt2_personachat_tokens_per_sec_chip_flash_attn"]
     gpt2_flash_512 = res["gpt2_personachat_tokens_per_sec_chip_T512_flash_attn"]
     flash_ab = res["flash_attn_t256_parity_dropout_kernel_ab"]
+    flash_ab_512 = res["flash_attn_t512_parity_dropout_kernel_ab"]
+    fused_ce_ab = res["gpt2_fused_ce_t512_ab"]
     sketch = res["gpt2_fetchsgd_sketch_rounds_per_sec"]
+    bucketed_256 = res["gpt2_fetchsgd_bucketed_rounds_t256_ab"]
+    bucketed_512 = res["gpt2_fetchsgd_bucketed_rounds_t512_ab"]
     sketch_exact = res["gpt2_fetchsgd_sketch_rounds_per_sec_exact_topk"]
     longctx = res["gpt2_longcontext_4k_blockwise_tokens_per_sec_chip"]
     offload = res["offload_gather_scatter_overlap"]
@@ -1045,10 +1179,37 @@ def main():
                     "flash block config vs XLA full attention with rbg "
                     "prob dropout (the incumbent's exact math)"})
         if flash_ab is not None else None)
+    add("flash_attn_t512_parity_dropout_kernel_ab",
+        round(flash_ab_512[0], 4) if flash_ab_512 is not None else None,
+        "speedup_x",
+        dict(flash_ab_512[1], **{
+            "note": "T=512 block-size re-tune sweep (up to the single-tile "
+                    "512x512); the winner sets _gpt2_fed_setup's "
+                    "attn_block_size pick for the T=512 federated rows"})
+        if flash_ab_512 is not None else None)
+    add("gpt2_fused_ce_t512_ab",
+        round(fused_ce_ab[0], 4) if fused_ce_ab is not None else None,
+        "speedup_x",
+        dict(fused_ce_ab[1], **{
+            "note": "fused head+CE vs materialized (B,C,T,V) logits inside "
+                    "the federated round at B=4 T=512 — the measured basis "
+                    "for --fused_ce auto"}) if fused_ce_ab is not None
+        else None)
     add("gpt2_fetchsgd_sketch_rounds_per_sec",
         round(sketch[0], 4) if sketch is not None else None, "rounds/sec",
         {"topk_approx_recall": 0.95,
          "note": "train_rounds_scan windows (K=6)"})
+    for label, bucketed in (("t256", bucketed_256), ("t512", bucketed_512)):
+        add(f"gpt2_fetchsgd_bucketed_rounds_{label}_ab",
+            round(bucketed[0], 4) if bucketed is not None else None,
+            "speedup_x",
+            dict(bucketed[1], **{
+                "note": "sketch round with --grad_buckets K in {1,4,16} "
+                        "(128-lane-aligned layer-grouped buckets, one "
+                        "sketch/psum op per bucket); K=1 is the "
+                        "trajectory-identical monolithic incumbent — "
+                        "docs/ROOFLINE.md Round 7"})
+            if bucketed is not None else None)
     add("gpt2_fetchsgd_sketch_rounds_per_sec_per_round_dispatch",
         round(sketch[1], 4) if sketch is not None else None, "rounds/sec",
         {"topk_approx_recall": 0.95,

@@ -28,17 +28,19 @@ from .prng_lint import lint_paths
 from .report import AuditReport, format_reports
 from .retrace import check_retrace
 from .rules import (DEFAULT_PATTERNS, DTYPE_ALLOW_PRIMITIVES,
-                    HOST_BOUNDARY_PRIMITIVES, SCATTER_PRIMITIVES, DtypeRule,
-                    FootprintRule, RuleReport, ShapePattern, TransferRule,
-                    Violation)
-from .targets import AuditTarget, build_targets
+                    HOST_BOUNDARY_PRIMITIVES, SCATTER_PRIMITIVES,
+                    BucketedTransmitRule, DtypeRule, FootprintRule,
+                    RuleReport, ShapePattern, TransferRule, Violation)
+from .targets import AuditTarget, build_targets, round_bucketed_target
 from .walker import EqnSite, WalkStats, collect_shapes, iter_eqns, walk
 
 __all__ = [
-    "AuditReport", "AuditTarget", "DtypeRule", "EqnSite", "FootprintRule",
-    "RuleReport", "ShapePattern", "TransferRule", "Violation", "WalkStats",
+    "AuditReport", "AuditTarget", "BucketedTransmitRule", "DtypeRule",
+    "EqnSite", "FootprintRule", "RuleReport", "ShapePattern", "TransferRule",
+    "Violation", "WalkStats",
     "audit", "build_targets", "check_retrace", "collect_shapes",
-    "format_reports", "iter_eqns", "lint_paths", "walk",
+    "format_reports", "iter_eqns", "lint_paths", "round_bucketed_target",
+    "walk",
     "DEFAULT_PATTERNS", "DTYPE_ALLOW_PRIMITIVES",
     "HOST_BOUNDARY_PRIMITIVES", "SCATTER_PRIMITIVES",
 ]

@@ -163,6 +163,133 @@ class FootprintRule:
 
 
 # --------------------------------------------------------------------------
+# bucketed transmit (--grad_buckets)
+# --------------------------------------------------------------------------
+
+
+class BucketedTransmitRule:
+    """The round's transmit is compressed/reduced per bucket, not
+    re-concatenated into one monolithic op.
+
+    The overlap win of ``--grad_buckets`` (federated/round.py
+    ``bucketed_compress``) exists only while each bucket's reduce/sketch
+    is an INDEPENDENT equation in the jaxpr — one op per bucket is what
+    XLA's latency-hiding scheduler can interleave with the backward and
+    issue as one psum per bucket on a mesh. A refactor that concatenates
+    the buckets back before compressing would be trajectory-identical
+    (so no trajectory test catches it) while silently restoring the
+    serial monolithic tail; this rule pins the STRUCTURE.
+
+    Two program shapes:
+
+    * ``kind='worker_reduce'`` (per-worker dense transmits): for every
+      plan bucket size ``s`` there must be a ``reduce_sum`` collapsing a
+      ``(W, s)`` operand over the worker axis, and NO ``reduce_sum`` may
+      collapse a full ``(W, d)`` operand (the monolithic transmit reduce;
+      (W, d) itself is legal here — local modes own per-sampled-client
+      state rows, which is why the footprint rule can't just ban the
+      shape).
+    * ``kind='sketch'`` (fused path, sketch-after-aggregate): every
+      bucket must feed its own ``sketch_range`` — on the CPU tier-1 walk
+      the non-routed sketch lowers each (row, bucket) to a scatter-add
+      producing a ``(c_eff,)`` table row from the bucket's ``(s,)``
+      chunk — and no ``(c_eff,)``-producing scatter-add may consume a
+      full ``(d,)`` updates vector (the monolithic ``sketch_vec``).
+      Both tests are gated on the ``(c_eff,)`` OUTPUT shape: the server's
+      unsketch legitimately scatters k values into a ``(d,)``
+      accumulator, so a bare operand-shape check would false-positive.
+
+    ``W`` is a constructor argument, NOT an audit dim: binding ``W`` in
+    ``dims`` would arm the footprint rule's (W, d) ban, which must stay
+    off for modes that legitimately own (W, d) state rows.
+    """
+
+    name = "bucketed"
+
+    def __init__(self, sizes: Sequence[int], kind: str,
+                 W: Optional[int] = None, c_eff: Optional[int] = None):
+        if kind not in ("worker_reduce", "sketch"):
+            raise ValueError(f"kind must be worker_reduce|sketch, "
+                             f"got {kind!r}")
+        if kind == "worker_reduce" and W is None:
+            raise ValueError("worker_reduce needs the worker-axis width W")
+        if kind == "sketch" and c_eff is None:
+            raise ValueError("sketch needs the physical table width c_eff")
+        if len(sizes) < 2:
+            raise ValueError("a bucketed audit needs >= 2 buckets "
+                             f"(plan has {len(sizes)})")
+        self.sizes = tuple(int(s) for s in sizes)
+        self.kind = kind
+        self.W = W
+        self.c_eff = c_eff
+
+    def _shapes(self, eqn):
+        def aval_shape(v):
+            aval = getattr(v, "aval", None)
+            return tuple(aval.shape) if hasattr(aval, "shape") else None
+        return ([aval_shape(v) for v in eqn.invars],
+                [aval_shape(v) for v in eqn.outvars])
+
+    def check(self, sites: Sequence[EqnSite], stats: WalkStats,
+              dims: dict) -> RuleReport:
+        d = int(dims["d"])
+        per_size = {s: 0 for s in self.sizes}
+        report = RuleReport(
+            rule=self.name, ok=True,
+            notes=f"kind={self.kind}; bucket sizes {self.sizes} "
+                  f"partition d={d}")
+        for site in sites:
+            report.checked_eqns += 1
+            ins, outs = None, None
+            if self.kind == "worker_reduce":
+                if site.primitive != "reduce_sum":
+                    continue
+                ins, outs = self._shapes(site.eqn)
+                op = ins[0] if ins else None
+                if op is None or len(op) != 2 or op[0] != self.W:
+                    continue
+                if op[1] == d and outs and outs[0] == (d,):
+                    report.ok = False
+                    report.violations.append(Violation(
+                        rule=self.name, path=site.path,
+                        primitive=site.primitive, shape=op,
+                        message=f"monolithic (W={self.W}, d={d}) transmit "
+                                f"reduce — buckets were re-concatenated "
+                                f"before the worker-axis reduce"))
+                elif op[1] in per_size and outs and outs[0] == (op[1],):
+                    per_size[op[1]] += 1
+            else:
+                if site.primitive != "scatter-add":
+                    continue
+                ins, outs = self._shapes(site.eqn)
+                if not outs or outs[0] != (self.c_eff,):
+                    continue
+                if (d,) in ins:
+                    report.ok = False
+                    report.violations.append(Violation(
+                        rule=self.name, path=site.path,
+                        primitive=site.primitive, shape=(d,),
+                        message=f"monolithic (d={d},) sketch scatter — "
+                                f"buckets were re-concatenated before "
+                                f"sketch_range"))
+                else:
+                    for s in self.sizes:
+                        if (s,) in ins:
+                            per_size[s] += 1
+        missing = [s for s, n in per_size.items() if n == 0]
+        if missing:
+            report.ok = False
+            report.violations.append(Violation(
+                rule=self.name, path="", primitive="<absent>",
+                message=f"no per-bucket {self.kind} op found for bucket "
+                        f"size(s) {missing} — expected one independent "
+                        f"compress/reduce eqn per bucket"))
+        report.notes += "; per-bucket ops seen: " + \
+            ", ".join(f"{s}:{n}" for s, n in per_size.items())
+        return report
+
+
+# --------------------------------------------------------------------------
 # transfer
 # --------------------------------------------------------------------------
 

@@ -24,8 +24,8 @@ import numpy as np
 
 from .report import AuditReport
 from .retrace import check_retrace
-from .rules import (DEFAULT_PATTERNS, FootprintRule, RuleReport, ShapePattern,
-                    TransferRule)
+from .rules import (DEFAULT_PATTERNS, BucketedTransmitRule, FootprintRule,
+                    RuleReport, ShapePattern, TransferRule)
 from .walker import walk
 
 
@@ -71,13 +71,13 @@ ROUND_CFGS = {
 FUSED_ROUND_MODES = ("sketch", "uncompressed")
 
 
-def _make_learner(num_workers=3, num_clients=7, **cfg_kw):
+def _make_learner(num_workers=3, num_clients=7, hidden=4, **cfg_kw):
     from commefficient_tpu.config import FedConfig
     from commefficient_tpu.federated.api import FedLearner
     from commefficient_tpu.federated.losses import make_cv_loss
     from commefficient_tpu.models import TinyMLP
 
-    model = TinyMLP(num_classes=2, hidden=4)
+    model = TinyMLP(num_classes=2, hidden=hidden)
     cfg = FedConfig(weight_decay=0, num_workers=num_workers,
                     num_clients=num_clients, lr_scale=0.05, **cfg_kw)
     return FedLearner(model, cfg, make_cv_loss(model), None,
@@ -124,6 +124,97 @@ def round_target(mode: str = "sketch") -> AuditTarget:
         trace=trace,
         dims=dims,
         rules=(FootprintRule(DEFAULT_PATTERNS), TransferRule()),
+        retrace=retrace)
+
+
+# --------------------------------------------------------------------------
+# bucketed federated round (--grad_buckets)
+# --------------------------------------------------------------------------
+
+def round_bucketed_target(variant: str = "local_topk",
+                          mutate: bool = False) -> AuditTarget:
+    """The bucketed transmit path (``--grad_buckets``, federated/round.py
+    ``bucketed_compress``) — the program whose *structure* is the point:
+    one independent compress/reduce eqn per bucket, so XLA's
+    latency-hiding scheduler can overlap bucket-k aggregation with
+    bucket-(k+1) backward and a mesh issues one psum per bucket.
+
+    Two variants, covering both transmit shapes:
+
+    * ``local_topk`` — per-worker dense transmits; the worker-axis
+      ``reduce_sum`` must appear once per bucket and never over the full
+      (W, d) stack.  TinyMLP hidden=4 (d=46) with a dense (align=1)
+      plan.
+    * ``sketch`` — fused path with sketch-after-aggregate; each bucket
+      feeds its own ``sketch_range`` and no full-(d,) ``sketch_vec``
+      remains.  TinyMLP hidden=64 (d=706) so the 128-aligned plan has a
+      real interior cut, num_cols=256 so c_eff collides with no bucket
+      size.
+
+    ``mutate=True`` builds the SAME config with ``grad_buckets=1`` — the
+    monolithic program a re-concatenation refactor would produce — while
+    keeping the K>1 plan in the rule.  The audit must FAIL on it
+    (tests/test_grad_buckets.py pins this), which is what makes a PASS
+    on the real program meaningful.
+    """
+    from commefficient_tpu.federated.state import make_grad_buckets
+    from commefficient_tpu.ops.countsketch import LANES, pad_cols
+
+    w, n_clients, K = 3, 7, 4
+    if variant == "sketch":
+        hidden, align = 64, LANES
+        cfg_kw = dict(ROUND_CFGS["sketch"], num_cols=256)
+    elif variant == "local_topk":
+        hidden, align = 4, 1
+        cfg_kw = dict(ROUND_CFGS["local_topk"])
+    else:
+        raise ValueError(f"variant must be local_topk|sketch, "
+                         f"got {variant!r}")
+    ln = _make_learner(num_workers=w, num_clients=n_clients, hidden=hidden,
+                       grad_buckets=1 if mutate else K, **cfg_kw)
+    d = int(ln.state.last_changed.shape[0])
+    plan = ln.grad_buckets or make_grad_buckets(
+        ln._param_leaf_sizes, ln.cfg.grad_dim, K, align=align)
+    assert plan is not None and plan.num_buckets >= 2, \
+        f"bucketed audit needs a >=2-bucket plan at d={d}"
+    batch, mask = _round_batch(w)
+    ids = jnp.arange(w, dtype=jnp.int32)
+
+    def trace():
+        return jax.make_jaxpr(ln._round.raw)(
+            ln.state, ids, batch, mask, jnp.float32(0.05),
+            jax.random.PRNGKey(0))
+
+    def retrace():
+        rng = np.random.RandomState(3)
+
+        def drive(i):
+            ids_i = rng.choice(n_clients, w, replace=False)
+            b, m = _round_batch(w, rng)
+            ln.train_round_async(ids_i, b, m)
+
+        return check_retrace(ln._round, None, repeats=3, warmup=1,
+                             drive=drive)
+
+    # W is bound as a footprint dim only where the fused path makes any
+    # (W, d) aval illegal; the bucketed rule gets W separately so it can
+    # police the worker reduce without arming the footprint ban for
+    # local modes that own (W, d) state rows.
+    dims = {"num_clients": n_clients, "d": d}
+    if variant in FUSED_ROUND_MODES:
+        dims["W"] = w
+    kind = "sketch" if variant == "sketch" else "worker_reduce"
+    return AuditTarget(
+        name=f"round_bucketed/{variant}" + ("(mutated)" if mutate else ""),
+        description=f"bucketed transmit, mode={variant}, "
+                    f"plan sizes {plan.sizes} (TinyMLP hidden={hidden})",
+        trace=trace,
+        dims=dims,
+        rules=(FootprintRule(DEFAULT_PATTERNS), TransferRule(),
+               BucketedTransmitRule(
+                   plan.sizes, kind=kind, W=w,
+                   c_eff=pad_cols(cfg_kw["num_cols"])
+                   if kind == "sketch" else None)),
         retrace=retrace)
 
 
@@ -464,11 +555,15 @@ def build_targets(name: str) -> list:
         return [sketch_target()]
     if name == "buffered":
         return [buffered_target()]
+    if name == "round_bucketed":
+        return [round_bucketed_target("local_topk"),
+                round_bucketed_target("sketch")]
     if name == "decode":
         return [decode_target("step"), decode_target("generate")]
     if name == "all":
-        return (build_targets("round") + build_targets("buffered")
-                + build_targets("gpt2") + build_targets("attention")
-                + build_targets("sketch") + build_targets("decode"))
-    raise ValueError(f"unknown audit target {name!r} "
-                     f"(round|buffered|gpt2|attention|sketch|decode|all)")
+        return (build_targets("round") + build_targets("round_bucketed")
+                + build_targets("buffered") + build_targets("gpt2")
+                + build_targets("attention") + build_targets("sketch")
+                + build_targets("decode"))
+    raise ValueError(f"unknown audit target {name!r} (round|round_bucketed|"
+                     f"buffered|gpt2|attention|sketch|decode|all)")

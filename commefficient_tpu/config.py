@@ -48,6 +48,15 @@ class FedConfig:
     # sketch/unsketch at default sizes); 'global' = classic per-coordinate
     # hashing (csvec-style). See ops/countsketch.py module docstring.
     sketch_scheme: str = "tiled"
+    # Number of transmit buckets (1 = monolithic, today's behavior). With
+    # K > 1 the round slices the flat gradient into K layer-grouped chunks
+    # (federated/state.py GradBuckets, boundaries aligned to the tiled
+    # sketch's 128-lane blocks) and compresses/reduces each chunk as an
+    # independent op, so XLA's latency-hiding scheduler can overlap bucket
+    # k's compression and cross-chip psum with bucket k+1's backward
+    # compute. Linearity of the sketch (PAPER.md) makes the bucketed table
+    # bit-compatible with the monolithic one; see docs/ROOFLINE.md Round 7.
+    grad_buckets: int = 1
     # 0.0 = exact top-k selection (reference parity). Setting a recall
     # target in (0, 1] switches every top-k in the pipeline (unsketch,
     # true_topk, local_topk, topk_down) to jax.lax.approx_max_k — the
@@ -173,6 +182,23 @@ class FedConfig:
         if self.offload_pipeline_depth < 1:
             raise ValueError("offload_pipeline_depth must be >= 1, got "
                              f"{self.offload_pipeline_depth}")
+        if self.grad_buckets < 1:
+            raise ValueError("grad_buckets must be >= 1, got "
+                             f"{self.grad_buckets}")
+        if self.grad_buckets > 1:
+            if self.server_mode == "buffered":
+                raise ValueError(
+                    "grad_buckets > 1 is incompatible with "
+                    "server_mode='buffered' (the contribution buffer "
+                    "deposits whole transmits; bucketing only restructures "
+                    "the lock-step reduce)")
+            if self.mode == "sketch" and (
+                    self.do_dp or self.max_grad_norm is not None):
+                raise ValueError(
+                    "grad_buckets > 1 requires a dense transmit; with "
+                    "mode='sketch' under DP or gradient clipping each "
+                    "worker transmits an already-compressed (r, c) table, "
+                    "so there is nothing left to bucket")
         if self.server_mode not in SERVER_MODES:
             raise ValueError(f"server_mode must be one of {SERVER_MODES}, "
                              f"got {self.server_mode!r}")

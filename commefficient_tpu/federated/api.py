@@ -29,7 +29,9 @@ from commefficient_tpu.config import FedConfig
 from commefficient_tpu.federated.round import (
     FedState, build_eval_step, build_round_step, init_fed_state)
 from commefficient_tpu.federated.state import (CLIENT_STATE_FIELDS,
-                                               ClientState)
+                                               ClientState,
+                                               make_grad_buckets)
+from commefficient_tpu.ops.countsketch import LANES
 from commefficient_tpu.utils.params import flatten_params
 from commefficient_tpu.utils.schedules import PiecewiseLinear
 
@@ -148,16 +150,31 @@ class FedLearner:
             trainable_mask = jnp.pad(
                 jnp.asarray(trainable_mask, jnp.float32),
                 (0, self.cfg.grad_dim - d_logical))  # pads stay frozen
+        # --grad_buckets: partition the flat gradient at param-leaf
+        # boundaries (tree_leaves order == flatten_params ravel order) so
+        # each bucket's compress/reduce is an independent op the scheduler
+        # can overlap with the rest of the backward (round.build_round_step
+        # docstring; docs/ROOFLINE.md Round 7). Sketch mode needs bucket
+        # edges on the tiled scheme's 128-lane blocks for sketch_range
+        # bit-compatibility; dense modes split at raw leaf boundaries.
+        self.grad_buckets = make_grad_buckets(
+            [leaf.size for leaf in jax.tree_util.tree_leaves(init_params)],
+            self.cfg.grad_dim, self.cfg.grad_buckets,
+            align=LANES if (self.cfg.mode == "sketch"
+                            and self.cfg.sketch_scheme == "tiled") else 1)
         self._round = build_round_step(loss_train, round_unflatten, self.cfg,
                                        mesh=mesh,
-                                       trainable_mask=trainable_mask)
+                                       trainable_mask=trainable_mask,
+                                       buckets=self.grad_buckets)
         self._eval = build_eval_step(loss_val or loss_train, unflatten)
         # stashed (post-padding) for subclasses that build additional
         # jitted programs over the same loss/parameterization
-        # (federated/buffer.BufferedFedLearner)
+        # (federated/buffer.BufferedFedLearner, bench.py A/B rebuilds)
         self._loss_train = loss_train
         self._round_unflatten = round_unflatten
         self._trainable_mask = trainable_mask
+        self._param_leaf_sizes = [
+            leaf.size for leaf in jax.tree_util.tree_leaves(init_params)]
         self.lr_schedule = lr_schedule or (lambda t: cfg.lr_scale)
         # optional (d,) per-coordinate LR multipliers (the reference's
         # per-param-group LR vector, fed_aggregator.py:411-427; built from

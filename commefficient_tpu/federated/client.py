@@ -166,7 +166,13 @@ def compute_gradient(apply_loss, unflatten, forward_weights, batch, mask,
     # with no per-worker nonlinearity the sum of sketches equals the
     # sketch of the sum, so the round sketches once after aggregation
     if cfg.mode == "sketch" and sketch is not None:
-        g = sketch.sketch_vec(grad)
+        # use_kernel is safe here even though client steps run under the
+        # round's per-worker vmap: the Pallas entry is batch-guarded
+        # (ops/sketch_kernels._batch_guard falls back to the bit-identical
+        # XLA formulation under vmap), so this opts in wherever the kernel
+        # can actually apply — e.g. a future unbatched per-client DP path —
+        # and costs nothing where it can't
+        g = sketch.sketch_vec(grad, use_kernel=True)
         if cfg.max_grad_norm is not None:
             # sketch-space clip via l2 estimate (ref fed_worker.py:317-319)
             est = sketch.l2estimate(g)

@@ -9,10 +9,95 @@ behavior without the aliasing hazards.
 
 from __future__ import annotations
 
-from typing import Optional
+import dataclasses
+from typing import Optional, Sequence, Tuple
 
 import jax
 from flax import struct
+
+
+@dataclasses.dataclass(frozen=True)
+class GradBuckets:
+    """Static plan slicing the flat ``(d,)`` gradient into K transmit
+    buckets (``--grad_buckets``).
+
+    Buckets are contiguous coordinate ranges cut at parameter-leaf
+    boundaries (layer-grouped, so each bucket's slice of the backward
+    finishes as a unit) and rounded to ``align`` — the tiled sketch's
+    128-lane block size when the aggregate is sketched, 1 for dense
+    transmits. The plan is a frozen tuple-of-ints object: hashable, so
+    the jitted round closes over it as a static value exactly like
+    FedConfig. Pad coordinates (grad_size..grad_dim) ride in the last
+    bucket; they are permanently zero so they add nothing anywhere.
+    """
+    offsets: Tuple[int, ...]  # ascending, offsets[0] == 0
+    sizes: Tuple[int, ...]    # sum(sizes) == grad_dim
+
+    def __post_init__(self):
+        if len(self.offsets) != len(self.sizes) or not self.offsets:
+            raise ValueError("offsets and sizes must be equal-length, "
+                             "non-empty")
+        if self.offsets[0] != 0:
+            raise ValueError("first bucket must start at coordinate 0")
+        for i in range(1, len(self.offsets)):
+            if self.offsets[i] != self.offsets[i - 1] + self.sizes[i - 1]:
+                raise ValueError("buckets must tile the flat vector "
+                                 "contiguously")
+        if any(s <= 0 for s in self.sizes):
+            raise ValueError("every bucket must be non-empty")
+
+    @property
+    def num_buckets(self) -> int:
+        return len(self.offsets)
+
+
+def make_grad_buckets(param_sizes: Sequence[int], grad_dim: int,
+                      num_buckets: int, align: int = 1
+                      ) -> Optional[GradBuckets]:
+    """Build the K-bucket plan for a model's flat gradient.
+
+    ``param_sizes`` are the leaf sizes of the trainable pytree in
+    ``jax.tree_util.tree_leaves`` order — the order ``flatten_params``
+    ravels them into the flat vector. Interior cuts are placed at the
+    param boundaries nearest the K equal-size targets, then rounded to a
+    multiple of ``align`` (the tiled sketch needs bucket edges on
+    128-lane block boundaries so per-bucket ``sketch_range`` tables sum
+    bit-compatibly with the monolithic table; see ops/countsketch.py).
+    Cuts that collide after rounding are dropped, so at toy scale the
+    realized bucket count may be < ``num_buckets``. Returns ``None``
+    when no interior cut survives (K <= 1, or the model is too small to
+    split at this alignment): the caller then runs the exact monolithic
+    code path, which is what makes ``--grad_buckets 1`` bitwise-identical
+    to pre-bucketing behavior.
+    """
+    if num_buckets <= 1 or grad_dim <= align:
+        return None
+    boundaries = []
+    acc = 0
+    for s in param_sizes:
+        acc += s
+        boundaries.append(acc)
+    # interior candidates only: a cut at 0 or >= grad_dim is not a cut
+    # (the final boundary == sum(param_sizes) stays a candidate when the
+    # flat vector is padded past it — the pad tail then forms the last
+    # bucket's tail, not its own bucket)
+    cand = sorted({min(b, grad_dim) for b in boundaries
+                   if 0 < b < grad_dim})
+    if not cand:
+        return None
+    cuts = []
+    for i in range(1, num_buckets):
+        target = grad_dim * i // num_buckets
+        nearest = min(cand, key=lambda b: abs(b - target))
+        snapped = (nearest + align // 2) // align * align
+        if 0 < snapped < grad_dim:
+            cuts.append(snapped)
+    cuts = sorted(set(cuts))
+    if not cuts:
+        return None
+    offsets = (0, *cuts)
+    sizes = tuple(b - a for a, b in zip(offsets, (*cuts, grad_dim)))
+    return GradBuckets(offsets=offsets, sizes=sizes)
 
 
 @struct.dataclass

@@ -294,13 +294,17 @@ class CountSketch:
 
     def _kernel_ok(self, use_kernel: bool) -> bool:
         """Pallas-kernel dispatch gate. The kernels are OPT-IN per call
-        site (``use_kernel=True``) because they are NOT vmap-safe: JAX's
-        pallas_call batching rule prepends the batch axis to the grid, so
-        ``pl.program_id(0)`` would become the batch index and the tiling
-        (and sketch_vec's step-0 accumulator init) would be silently wrong
-        (review r4). The federated round's per-worker vmap path therefore
-        never opts in; the aggregate-side call sites (round.py
-        sketch-after-aggregate, server.py unsketch) do."""
+        site (``use_kernel=True``). They are batch-SAFE: each public entry
+        is wrapped in a ``custom_vmap`` whose batching rule abandons the
+        kernel for the bit-identical XLA formulation (sketch_kernels.
+        _batch_guard) — JAX's default pallas_call batching rule would
+        prepend the batch axis to the grid, turning ``pl.program_id(0)``
+        into the batch index and silently corrupting the tiling and the
+        sketch accumulator's step-0 init (review r4; the hazard that
+        previously kept the per-worker vmap paths off the kernel). Under
+        vmap the call therefore just doesn't get the kernel; unbatched
+        call sites (round.py sketch-after-aggregate, server.py unsketch)
+        get it as before."""
         if not use_kernel:
             return False
         from commefficient_tpu.ops.sketch_kernels import kernel_supported
@@ -312,32 +316,76 @@ class CountSketch:
     def sketch_vec(self, vec: jax.Array,
                    use_kernel: bool = False) -> jax.Array:
         """Sketch a length-d vector into an (r, c_eff) table."""
-        if self.scheme == "tiled" and self._use_routed():
-            # Pallas kernel (see estimates below): out_ref doubles as the
-            # VMEM-resident accumulator. Bit-identical; measured 16.8 ms
-            # vs 24.9 ms for the XLA path at d=6.5M, 5x500k (quiet chip).
+        return self.sketch_range(vec, 0, use_kernel)
+
+    @partial(jax.jit, static_argnums=(0, 2, 3))
+    def sketch_range(self, chunk: jax.Array, offset: int = 0,
+                     use_kernel: bool = False) -> jax.Array:
+        """Sketch the contiguous slice ``vec[offset : offset+len(chunk)]``
+        of a conceptual length-d vector into a full (r, c_eff) table.
+
+        Linearity makes the sketch of a vector the sum of the sketches of
+        its slices, so a bucketed transmit (``--grad_buckets``)
+        accumulates per-bucket tables into the same table ``sketch_vec``
+        builds monolithically. Hashes are keyed by GLOBAL coordinate and
+        block ids, so every contribution lands in exactly the cell the
+        monolithic path would put it, and within a bucket each window
+        still sums in ascending block order (the routed/unrouted
+        bit-identity argument, unchanged). Across buckets the per-cell
+        sums associate bucket-by-bucket instead of strictly
+        block-by-block: equal in exact arithmetic, equal to f32 rounding
+        in practice (tests/test_grad_buckets.py pins the tolerance;
+        ``offset=0`` with the full vector IS the monolithic path,
+        bitwise).
+
+        The tiled scheme requires ``offset`` on a 128-lane block boundary
+        — the GradBuckets planner aligns bucket edges for exactly this
+        reason.
+
+        Dispatch mirrors ``sketch_vec``: Pallas kernel (offset-aware
+        grid) when ``use_kernel`` and eligible — measured 16.8 ms vs
+        24.9 ms for the XLA path at d=6.5M, 5x500k (quiet chip) — else
+        the XOR-butterfly routed formulation on TPU backends, else the
+        per-coordinate segment_sum on CPU/GPU.
+        """
+        n = chunk.shape[0]
+        if offset < 0 or offset + n > self.d:
+            raise ValueError(f"slice [{offset}, {offset + n}) outside the "
+                             f"sketch's coordinate space [0, {self.d})")
+        if self.scheme == "tiled":
+            if offset % LANES:
+                raise ValueError(
+                    f"tiled sketch_range needs a {LANES}-aligned offset, "
+                    f"got {offset} (GradBuckets aligns bucket edges)")
             if self._kernel_ok(use_kernel):
                 from commefficient_tpu.ops.sketch_kernels import \
                     sketch_vec_pallas
-                return sketch_vec_pallas(self, vec)
-            vp = vec
-            if self.d_pad != self.d:
-                vp = jnp.pad(vec, (0, self.d_pad - self.d))
-            rows = []
-            for row in range(self.r):
-                signs, off, base = self._row_tiled(row)
-                lanemask = off[:, 0].astype(jnp.uint32)  # off[b,l] = l ^ m_b
-                win = _permute_xor(vp.reshape(self.nblocks, LANES) * signs,
-                                   lanemask)
-                rows.append(jax.ops.segment_sum(
-                    win, base, num_segments=self.nwindows).reshape(-1))
-            return jnp.stack(rows)
+                return sketch_vec_pallas(self, chunk,
+                                         block_offset=offset // LANES)
+            if self._use_routed():
+                nb = -(-n // LANES)
+                vp = chunk if n == nb * LANES else \
+                    jnp.pad(chunk, (0, nb * LANES - n))
+                blk = (jnp.uint32(offset // LANES)
+                       + jnp.arange(nb, dtype=jnp.uint32))
+                idx = (jnp.uint32(offset)
+                       + jnp.arange(nb * LANES, dtype=jnp.uint32))
+                rows = []
+                for row in range(self.r):
+                    signs = self._row_signs(row, idx).reshape(nb, LANES)
+                    base, lanemask = self._block_hashes(row, blk)
+                    win = _permute_xor(vp.reshape(nb, LANES) * signs,
+                                       lanemask)
+                    rows.append(jax.ops.segment_sum(
+                        win, base.astype(jnp.int32),
+                        num_segments=self.nwindows).reshape(-1))
+                return jnp.stack(rows)
 
-        idx = jnp.arange(self.d, dtype=jnp.int32)
+        idx = offset + jnp.arange(n, dtype=jnp.int32)
 
         def one_row(row):
             signs, buckets = self._row_hashes(row, idx)
-            return jax.ops.segment_sum(signs * vec, buckets,
+            return jax.ops.segment_sum(signs * chunk, buckets,
                                        num_segments=self.c_eff)
 
         return jnp.stack([one_row(row) for row in range(self.r)])

@@ -83,6 +83,31 @@ def _butterfly_xor(x, lanemask):
     return x
 
 
+def _batch_guard(kernel_call, xla_fallback):
+    """Batch-safe dispatch for a single-operand Pallas entry point.
+
+    JAX's default pallas_call batching rule prepends the batch axis to
+    the GRID, so under ``vmap`` ``pl.program_id(0)`` becomes the batch
+    index: the tiling — and the sketch kernel's step-0 accumulator init —
+    would be silently wrong (the review-r4 hazard that used to make the
+    kernels a per-call-site opt-in the vmapped per-worker paths could
+    never take). This ``custom_vmap`` overrides that rule: a batched call
+    abandons the kernel and maps the bit-identical XLA formulation
+    instead, so ``use_kernel=True`` is safe everywhere and simply doesn't
+    get the kernel where it can't apply. Unbatched calls are untouched.
+    """
+    run = jax.custom_batching.custom_vmap(kernel_call)
+
+    @run.def_vmap
+    def _rule(axis_size, in_batched, x):
+        del axis_size
+        (x_batched,) = in_batched
+        out = jax.vmap(xla_fallback)(x) if x_batched else xla_fallback(x)
+        return out, x_batched
+
+    return run
+
+
 def _estimates_kernel(table_ref, out_ref, win, *, coeffs, nwindows, r):
     i0 = pl.program_id(0)
 
@@ -116,22 +141,30 @@ def estimates_pallas(cs, table, interpret: bool = False):
     """All-coordinate estimates for a tiled-scheme CountSketch ``cs``.
 
     Drop-in for ``cs.estimates(table)`` when ``kernel_supported(cs)``;
-    ``interpret=True`` runs the Pallas interpreter (CPU tests)."""
+    ``interpret=True`` runs the Pallas interpreter (CPU tests). Batch-safe
+    (_batch_guard): a vmapped call maps ``cs.estimates`` instead."""
     n_tiles = -(-cs.nblocks // TILE_BLOCKS)
-    out = pl.pallas_call(
-        partial(_estimates_kernel, coeffs=cs.coeffs, nwindows=cs.nwindows,
-                r=cs.r),
-        grid=(n_tiles,),
-        in_specs=[pl.BlockSpec((cs.r, cs.c_eff), lambda i: (0, 0),
-                               memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec((TILE_BLOCKS, LANES), lambda i: (i, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((n_tiles * TILE_BLOCKS, LANES),
-                                       jnp.float32),
-        scratch_shapes=[pltpu.VMEM((cs.r, TILE_BLOCKS, LANES), jnp.float32)],
-        interpret=interpret,
-    )(table)
-    return out.reshape(-1)[:cs.d]
+
+    def kernel_call(tab):
+        out = pl.pallas_call(
+            partial(_estimates_kernel, coeffs=cs.coeffs,
+                    nwindows=cs.nwindows, r=cs.r),
+            grid=(n_tiles,),
+            in_specs=[pl.BlockSpec((cs.r, cs.c_eff), lambda i: (0, 0),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((TILE_BLOCKS, LANES), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((n_tiles * TILE_BLOCKS, LANES),
+                                           jnp.float32),
+            scratch_shapes=[pltpu.VMEM((cs.r, TILE_BLOCKS, LANES),
+                                       jnp.float32)],
+            interpret=interpret,
+        )(tab)
+        return out.reshape(-1)[:cs.d]
+
+    return _batch_guard(kernel_call,
+                        lambda tab: cs.estimates(tab, use_kernel=False)
+                        )(table)
 
 
 def kernel_supported(cs) -> bool:
@@ -141,7 +174,8 @@ def kernel_supported(cs) -> bool:
             and cs.r * cs.c_eff * 4 <= VMEM_TABLE_BUDGET)
 
 
-def _sketch_kernel(vec_ref, out_ref, win, *, coeffs, nwindows, r, n_tiles):
+def _sketch_kernel(vec_ref, out_ref, win, *, coeffs, nwindows, r,
+                   block_offset):
     """Scatter direction: TPU grid steps run SEQUENTIALLY on a core, and
     the output block's index_map is constant, so ``out_ref`` itself is the
     VMEM-resident accumulator across steps (a separate scratch table
@@ -149,8 +183,10 @@ def _sketch_kernel(vec_ref, out_ref, win, *, coeffs, nwindows, r, n_tiles):
     needs no atomics. Additions hit each window in ascending block order —
     the same order as the XLA paths (segment_sum groups by base in block
     order; the XOR permutation guarantees one value per bucket per block),
-    so the result is bit-identical."""
-    del n_tiles
+    so the result is bit-identical. ``block_offset`` shifts the GLOBAL
+    block ids the hashes key on: the grid covers one transmit bucket's
+    blocks (countsketch.sketch_range) while every contribution still lands
+    in the cell the monolithic sketch would put it."""
     i0 = pl.program_id(0)
 
     @pl.when(i0 == 0)
@@ -159,7 +195,7 @@ def _sketch_kernel(vec_ref, out_ref, win, *, coeffs, nwindows, r, n_tiles):
 
     # vectorized: sign-multiply + XOR-permute the tile (the butterfly is an
     # involution: the same permute serves scatter and gather)
-    blk_vec = (_U(i0) * _U(TILE_BLOCKS)
+    blk_vec = (_U(block_offset) + _U(i0) * _U(TILE_BLOCKS)
                + jax.lax.broadcasted_iota(_U, (TILE_BLOCKS, LANES), 0))
     lane = jax.lax.broadcasted_iota(_U, (TILE_BLOCKS, LANES), 1)
     idx = blk_vec * _U(LANES) + lane
@@ -171,7 +207,7 @@ def _sketch_kernel(vec_ref, out_ref, win, *, coeffs, nwindows, r, n_tiles):
 
     # scalar: accumulate each block's window at its hashed base
     def body(i, carry):
-        blk = _U(i0) * _U(TILE_BLOCKS) + _U(i)
+        blk = _U(block_offset) + _U(i0) * _U(TILE_BLOCKS) + _U(i)
         for row in range(r):
             mb, _ = _block_hash(coeffs[row], blk)
             base = (mb % _U(nwindows)).astype(jnp.int32)
@@ -182,24 +218,40 @@ def _sketch_kernel(vec_ref, out_ref, win, *, coeffs, nwindows, r, n_tiles):
     jax.lax.fori_loop(0, TILE_BLOCKS, body, 0)
 
 
-@partial(jax.jit, static_argnames=("cs", "interpret"))
-def sketch_vec_pallas(cs, vec, interpret: bool = False):
-    """Drop-in for ``cs.sketch_vec(vec)`` when ``kernel_supported(cs)``."""
-    n_tiles = -(-cs.nblocks // TILE_BLOCKS)
-    # zero-pad so tail-tile blocks contribute exact zeros to their windows
-    vp = jnp.pad(vec, (0, n_tiles * TILE_BLOCKS * LANES - cs.d)
-                 ).reshape(n_tiles * TILE_BLOCKS, LANES)
-    return pl.pallas_call(
-        partial(_sketch_kernel, coeffs=cs.coeffs, nwindows=cs.nwindows,
-                r=cs.r, n_tiles=n_tiles),
-        grid=(n_tiles,),
-        in_specs=[pl.BlockSpec((TILE_BLOCKS, LANES), lambda i: (i, 0),
-                               memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec((cs.r, cs.c_eff), lambda i: (0, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((cs.r, cs.c_eff), jnp.float32),
-        scratch_shapes=[
-            pltpu.VMEM((cs.r, TILE_BLOCKS, LANES), jnp.float32),
-        ],
-        interpret=interpret,
-    )(vp)
+@partial(jax.jit, static_argnames=("cs", "interpret", "block_offset"))
+def sketch_vec_pallas(cs, vec, interpret: bool = False,
+                      block_offset: int = 0):
+    """Drop-in for ``cs.sketch_vec(vec)`` when ``kernel_supported(cs)``.
+
+    ``vec`` may be a bucket slice shorter than d; ``block_offset`` is its
+    first coordinate's block id (countsketch.sketch_range dispatches
+    ``offset // 128``). Batch-safe (_batch_guard): a vmapped call maps the
+    XLA sketch_range instead of mis-gridding the kernel."""
+    n = vec.shape[0]
+    n_blocks = -(-n // LANES)
+    n_tiles = -(-n_blocks // TILE_BLOCKS)
+
+    def kernel_call(v):
+        # zero-pad so tail-tile blocks contribute exact zeros to their
+        # windows
+        vp = jnp.pad(v, (0, n_tiles * TILE_BLOCKS * LANES - n)
+                     ).reshape(n_tiles * TILE_BLOCKS, LANES)
+        return pl.pallas_call(
+            partial(_sketch_kernel, coeffs=cs.coeffs, nwindows=cs.nwindows,
+                    r=cs.r, block_offset=block_offset),
+            grid=(n_tiles,),
+            in_specs=[pl.BlockSpec((TILE_BLOCKS, LANES), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((cs.r, cs.c_eff), lambda i: (0, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((cs.r, cs.c_eff), jnp.float32),
+            scratch_shapes=[
+                pltpu.VMEM((cs.r, TILE_BLOCKS, LANES), jnp.float32),
+            ],
+            interpret=interpret,
+        )(vp)
+
+    return _batch_guard(
+        kernel_call,
+        lambda v: cs.sketch_range(v, block_offset * LANES, use_kernel=False)
+    )(vec)

@@ -59,6 +59,14 @@ def build_parser(default_lr: float = 0.4) -> argparse.ArgumentParser:
                    default="tiled",
                    help="tiled = TPU lane-tile windowed hashing (fast); "
                         "global = classic per-coordinate hashing")
+    p.add_argument("--grad_buckets", type=int, default=1,
+                   help="transmit buckets K (1 = monolithic): slice the "
+                        "flat gradient into K layer-grouped chunks and "
+                        "compress/reduce each as an independent op so XLA "
+                        "overlaps bucket-k compression/psum with bucket-"
+                        "(k+1) backward compute (docs/ROOFLINE.md Round 7)."
+                        " Trajectory-equivalent to K=1 "
+                        "(tests/test_grad_buckets.py)")
     p.add_argument("--topk_down", action="store_true", dest="do_topk_down")
     p.add_argument("--topk_approx_recall", type=float, default=0.0,
                    help="0 = exact top-k; in (0,1] = TPU approx_max_k with "

@@ -51,6 +51,20 @@ def test_round_retrace_guard_zero_recompiles(audited):
     assert rt.checked_eqns == 4  # 1 warmup + 3 measured calls
 
 
+@pytest.mark.parametrize("idx,variant", [(0, "local_topk"), (1, "sketch")])
+def test_round_bucketed_audit_passes_with_retrace(audited, idx, variant):
+    """The K=4 bucketed round passes the transmit-structure rules (no
+    monolithic (W, d) reduce or (d,) sketch scatter, >=2 independent
+    per-bucket transmit ops) AND stays retrace-flat when driven through
+    train_round_async.  The negative direction — the audit FAILS when
+    buckets are re-concatenated before compression — is pinned by the
+    mutation test in tests/test_grad_buckets.py."""
+    rep = audited("round_bucketed", idx, with_retrace=True)
+    assert rep.target == f"round_bucketed/{variant}"
+    assert rep.ok, rep.format()
+    assert rep.rule("bucketed").ok
+
+
 def test_gpt2_train_step_audit_passes_and_visits_remat(audited):
     rep = audited("gpt2")
     assert rep.ok, rep.format()

@@ -34,10 +34,14 @@ def test_registry_covers_every_row():
     a row cannot exist in one mode and be silently skipped by the
     other."""
     names = [n for n, _ in bench._bench_rows()]
-    assert len(names) == len(set(names)) == 14
+    assert len(names) == len(set(names)) == 18
     for must in ("cifar10_resnet9_fed_rounds_per_sec",
                  "gpt2_personachat_tokens_per_sec_chip_flash_attn",
                  "flash_attn_t256_parity_dropout_kernel_ab",
+                 "flash_attn_t512_parity_dropout_kernel_ab",
+                 "gpt2_fused_ce_t512_ab",
+                 "gpt2_fetchsgd_bucketed_rounds_t256_ab",
+                 "gpt2_fetchsgd_bucketed_rounds_t512_ab",
                  "gpt2_longcontext_4k_blockwise_tokens_per_sec_chip",
                  "offload_gather_scatter_overlap",
                  "buffered_fedbuff_round_overhead",
@@ -60,6 +64,30 @@ def test_flash_ab_row_traces_every_config(dry):
     # 4 block-size sweep entries + nodropout + xla_full, all traced
     assert status["configs"] == 6
     assert all(v != v for v in results.values())  # NaN placeholders only
+
+
+def test_flash_t512_sweep_traces_single_tile_blocks(dry):
+    status, results = bench.bench_flash_dropout_kernel_ab(
+        T=512, blocks=((512, 512), (256, 256)))
+    assert status["dry_run"] == "ok"
+    assert "flash_dropout_bq512_bk512_ms" in results
+
+
+def test_cli_glob_row_filter_matches_bucketed_rows(monkeypatch, capsys):
+    """CI selects the bucketed rows with a quoted glob — the filter must
+    treat '*bucket*' as a glob, not a literal substring. The row body is
+    stubbed (the registry is late-bound for exactly this): the real
+    gpt2-small trace is the CI step's job, this pins the SELECTION."""
+    calls = []
+    monkeypatch.setattr(bench, "bench_gpt2_bucketed_rounds",
+                        lambda T=256, Ks=(1, 4, 16): calls.append(T))
+    failed = bench._dry_run_main(row_filter="*bucket*")
+    out = capsys.readouterr().out
+    assert failed == 0
+    assert calls == [256, 512]
+    assert "gpt2_fetchsgd_bucketed_rounds_t256_ab" in out
+    assert "gpt2_fetchsgd_bucketed_rounds_t512_ab" in out
+    assert "cifar10" not in out
 
 
 def test_offload_row_traces_the_offload_round_signature(dry):

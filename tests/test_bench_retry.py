@@ -115,9 +115,19 @@ def test_main_emits_json_and_exits_zero_despite_failed_metrics(
         per_dispatch=True: (1000.0, 900.0 if per_dispatch else None))
     monkeypatch.setattr(
         bench, "bench_flash_dropout_kernel_ab",
-        lambda: (1.3, {"flash_dropout_bq256_bk256_ms": 8.0,
-                       "xla_full_prob_dropout_ms": 10.4,
-                       "best_flash_dropout_ms": 8.0}))
+        lambda T=256, rate=0.1, blocks=None:
+        (1.3, {f"flash_dropout_bq{T}_bk{T}_ms": 8.0,
+               "xla_full_prob_dropout_ms": 10.4,
+               "best_flash_dropout_ms": 8.0}))
+    monkeypatch.setattr(
+        bench, "bench_gpt2_fused_ce_ab",
+        lambda T=512: (1.1, {"materialized_logits_tok_s": 60_000.0,
+                             "fused_ce_tok_s": 66_000.0}))
+    monkeypatch.setattr(
+        bench, "bench_gpt2_bucketed_rounds",
+        lambda T=256, Ks=(1, 4, 16):
+        (1.2, {f"bucketed_K{K}_ms": 100.0 / (1.0 + 0.1 * i)
+               for i, K in enumerate(Ks)}))
 
     monkeypatch.setattr(
         bench, "bench_generate",
@@ -144,6 +154,8 @@ def test_main_emits_json_and_exits_zero_despite_failed_metrics(
     metrics = {e["metric"] for e in out["extra_metrics"]}
     assert "gpt2_personachat_tokens_per_sec_chip" in metrics
     assert "gpt2_decode_tokens_per_sec_chip_b64" in metrics
+    assert "gpt2_fetchsgd_bucketed_rounds_t512_ab" in metrics
+    assert "gpt2_fused_ce_t512_ab" in metrics
     # the dead metrics are absent from the numbers but present in errors
     assert "gpt2_fetchsgd_sketch_rounds_per_sec" not in metrics
     failed = {e["metric"] for e in out["errors"]}

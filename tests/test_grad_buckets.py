@@ -1,0 +1,310 @@
+"""--grad_buckets: bucketed transmit compression (federated/round.py
+``bucketed_compress``, federated/state.py ``GradBuckets``).
+
+The contract under test, in three layers:
+
+1. PLAN — ``make_grad_buckets`` tiles [0, d) contiguously at layer
+   boundaries snapped to the requested alignment, and degenerates to
+   ``None`` (→ the literal pre-bucketing code path) for K=1 or
+   unsplittable dims.
+2. MATH — bucketing never changes the trajectory. Dense-transmit modes
+   (uncompressed / true_topk / local_topk) are BITWISE identical: the
+   per-coordinate worker sum is untouched, slicing commutes with the
+   elementwise divide, and concatenation is exact. Sketch-after-
+   aggregate accumulates per-bucket tables, so each cell's sum
+   associates bucket-by-bucket instead of strictly block-by-block:
+   equal in exact arithmetic, tight f32 tolerance here (the
+   ops/countsketch.py ``sketch_range`` docstring documents this — the
+   one place the ISSUE's "bitwise where summation order preserved"
+   carve-out applies).
+3. STRUCTURE — the graft-audit ``round_bucketed`` target PASSES on the
+   bucketed program and FAILS on the re-concatenated (monolithic)
+   mutation, so a refactor that quietly restores the serial transmit
+   tail cannot survive CI even though it is trajectory-identical.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from commefficient_tpu.config import FedConfig
+from commefficient_tpu.federated.state import GradBuckets, make_grad_buckets
+from commefficient_tpu.ops.countsketch import LANES, CountSketch
+
+
+# --------------------------------------------------------------------------
+# plan
+# --------------------------------------------------------------------------
+
+def test_planner_tiles_at_layer_boundaries():
+    # leaf sizes of a 2-layer MLP: cuts must land on cumsum boundaries
+    plan = make_grad_buckets([6, 24, 2, 12], 44, 4, align=1)
+    assert plan is not None
+    assert plan.offsets[0] == 0
+    assert sum(plan.sizes) == 44
+    assert list(plan.offsets) == sorted(plan.offsets)
+    boundaries = {6, 30, 32, 44}
+    assert all(off in boundaries for off in plan.offsets[1:])
+    assert plan.num_buckets == 4
+
+
+def test_planner_snaps_to_alignment():
+    sizes = [64, 512, 2, 128]           # d = 706, boundaries 64/576/578
+    plan = make_grad_buckets(sizes, 706, 4, align=LANES)
+    assert plan is not None
+    assert all(off % LANES == 0 for off in plan.offsets)
+    assert sum(plan.sizes) == 706
+    assert plan.num_buckets >= 2
+
+
+def test_planner_degenerates_to_none():
+    assert make_grad_buckets([6, 24, 2, 12], 44, 1) is None
+    # alignment swallows every candidate cut
+    assert make_grad_buckets([6, 24, 2, 12], 44, 4, align=LANES) is None
+    assert make_grad_buckets([44], 44, 0) is None
+
+
+def test_grad_buckets_rejects_non_tilings():
+    with pytest.raises(ValueError, match="contiguously"):
+        GradBuckets(offsets=(0, 12), sizes=(10, 20))   # gap at 10..12
+    with pytest.raises(ValueError, match="start at coordinate 0"):
+        GradBuckets(offsets=(5, 10), sizes=(5, 5))
+    with pytest.raises(ValueError, match="non-empty"):
+        GradBuckets(offsets=(0, 10), sizes=(10, 0))    # empty bucket
+    GradBuckets(offsets=(0, 10), sizes=(10, 7))        # valid tiling
+
+
+# --------------------------------------------------------------------------
+# config surface
+# --------------------------------------------------------------------------
+
+def test_config_rejects_nonpositive_buckets():
+    with pytest.raises(ValueError, match="grad_buckets"):
+        FedConfig(grad_buckets=0).validate()
+
+
+def test_config_rejects_buckets_with_buffered_server():
+    with pytest.raises(ValueError, match="buffered"):
+        FedConfig(grad_buckets=4, server_mode="buffered",
+                  mode="local_topk", error_type="local", k=3,
+                  local_momentum=0.9, virtual_momentum=0).validate()
+
+
+def test_config_rejects_buckets_with_per_worker_sketch_transmit():
+    # DP / clipping force each worker to transmit an already-compressed
+    # (r, c) table — there is no dense vector left to bucket
+    with pytest.raises(ValueError, match="dense transmit"):
+        FedConfig(grad_buckets=4, mode="sketch", error_type="virtual",
+                  virtual_momentum=0.9, k=3, num_rows=3, num_cols=20,
+                  do_dp=True, noise_multiplier=0.1).validate()
+    with pytest.raises(ValueError, match="dense transmit"):
+        FedConfig(grad_buckets=4, mode="sketch", error_type="virtual",
+                  virtual_momentum=0.9, k=3, num_rows=3, num_cols=20,
+                  max_grad_norm=1.0).validate()
+    # plain sketch (no DP/clip) runs sketch-after-aggregate and buckets
+    FedConfig(grad_buckets=4, mode="sketch", error_type="virtual",
+              virtual_momentum=0.9, k=3, num_rows=3,
+              num_cols=20).validate()
+
+
+# --------------------------------------------------------------------------
+# sketch_range: linearity against the monolithic sketch
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("scheme,offsets", [
+    ("tiled", (0, 128, 512)),      # 128-aligned cuts, as the planner emits
+    ("global", (0, 37, 500)),      # global scheme needs no alignment
+])
+def test_sketch_range_buckets_sum_to_monolithic(scheme, offsets):
+    d, c, r = 1000, 256, 3
+    cs = CountSketch(d=d, c=c, r=r, seed=11, scheme=scheme)
+    vec = jnp.asarray(np.random.RandomState(0).randn(d).astype(np.float32))
+    mono = cs.sketch_vec(vec)
+    edges = list(offsets) + [d]
+    table = None
+    for off, end in zip(edges[:-1], edges[1:]):
+        part = cs.sketch_range(vec[off:end], off)
+        table = part if table is None else table + part
+    # bucket-by-bucket association vs block-by-block: equal in exact
+    # arithmetic, f32-tight in practice (see module docstring)
+    np.testing.assert_allclose(np.asarray(table), np.asarray(mono),
+                               rtol=2e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("scheme", ["tiled", "global"])
+def test_sketch_range_offset_zero_is_monolithic_bitwise(scheme):
+    d = 700
+    cs = CountSketch(d=d, c=128, r=3, seed=5, scheme=scheme)
+    vec = jnp.asarray(np.random.RandomState(1).randn(d).astype(np.float32))
+    np.testing.assert_array_equal(np.asarray(cs.sketch_range(vec, 0)),
+                                  np.asarray(cs.sketch_vec(vec)))
+
+
+def test_sketch_range_rejects_bad_slices():
+    cs = CountSketch(d=1000, c=256, r=3, seed=3)   # tiled default
+    vec = jnp.zeros((100,), jnp.float32)
+    with pytest.raises(ValueError, match="aligned"):
+        cs.sketch_range(vec, 64)                    # not a block boundary
+    with pytest.raises(ValueError, match="outside"):
+        cs.sketch_range(vec, 1024)                  # runs past d
+    with pytest.raises(ValueError, match="outside"):
+        cs.sketch_range(vec, -128)
+
+
+# --------------------------------------------------------------------------
+# trajectory equivalence: K buckets vs the monolithic round
+# --------------------------------------------------------------------------
+
+MODE_CFGS = {
+    "uncompressed": dict(mode="uncompressed", error_type="none",
+                         virtual_momentum=0.9),
+    "true_topk": dict(mode="true_topk", error_type="virtual", k=3,
+                      virtual_momentum=0.9),
+    "local_topk": dict(mode="local_topk", error_type="local", k=3,
+                       local_momentum=0.9, virtual_momentum=0),
+    "sketch": dict(mode="sketch", error_type="virtual", k=3, num_rows=3,
+                   num_cols=256, virtual_momentum=0.9),
+    "sketch_global": dict(mode="sketch", error_type="virtual", k=3,
+                          num_rows=3, num_cols=64, virtual_momentum=0.9,
+                          sketch_scheme="global"),
+    "sketch_quarantine": dict(mode="sketch", error_type="virtual", k=3,
+                              num_rows=3, num_cols=256,
+                              virtual_momentum=0.9, client_quarantine=True,
+                              quarantine_rounds=2),
+}
+
+
+def _run_rounds(cfg_kw, hidden, num_buckets, rounds=3):
+    """3 rounds of the real round program, bucketed per ``num_buckets``
+    (0 = build with buckets=None, the pre-bucketing program)."""
+    from commefficient_tpu.federated.losses import make_cv_loss
+    from commefficient_tpu.federated.round import (build_round_step,
+                                                   init_fed_state)
+    from commefficient_tpu.models import TinyMLP
+    from commefficient_tpu.utils.params import flatten_params
+
+    model = TinyMLP(num_classes=2, hidden=hidden)
+    rng = np.random.RandomState(0)
+    W, B = 3, 5
+    Xs = rng.randn(W, B, 4).astype(np.float32)
+    ys = (Xs[:, :, 0] > 0).astype(np.int32)
+    mask = np.ones((W, B), np.float32)
+    mask[2, 3:] = 0.0
+    ids = np.array([0, 1, 2])
+
+    params = model.init(jax.random.PRNGKey(3), Xs[0][:1],
+                        train=False)["params"]
+    flat, unflatten = flatten_params(params)
+    flat = np.asarray(flat)
+    leaf_sizes = [leaf.size for leaf in jax.tree_util.tree_leaves(params)]
+    cfg = FedConfig(num_workers=W, num_clients=4, lr_scale=0.1,
+                    weight_decay=0, grad_buckets=max(num_buckets, 1),
+                    **cfg_kw).finalize(flat.shape[0])
+    align = LANES if (cfg.mode == "sketch"
+                      and cfg.sketch_scheme == "tiled") else 1
+    plan = (make_grad_buckets(leaf_sizes, cfg.grad_dim, num_buckets,
+                              align=align) if num_buckets > 1 else None)
+    if num_buckets > 1:
+        assert plan is not None and plan.num_buckets >= 2, \
+            f"test shape too small to bucket at align={align}"
+    step = build_round_step(make_cv_loss(model), unflatten, cfg,
+                            buckets=plan)
+    state = init_fed_state(cfg, jnp.asarray(flat))
+    for r in range(rounds):
+        state, _ = step(state, jnp.asarray(ids),
+                        (jnp.asarray(Xs), jnp.asarray(ys)),
+                        jnp.asarray(mask), 0.1, jax.random.PRNGKey(7 + r))
+    return np.asarray(state.weights)
+
+
+@pytest.mark.parametrize("mode", ["uncompressed", "true_topk",
+                                  "local_topk"])
+def test_dense_modes_bucketed_bitwise_identical(mode):
+    # dense transmits: per-coordinate math is untouched by the split, so
+    # K=4 must be BITWISE equal to the monolithic program
+    w_mono = _run_rounds(MODE_CFGS[mode], hidden=6, num_buckets=0)
+    w_bucketed = _run_rounds(MODE_CFGS[mode], hidden=6, num_buckets=4)
+    np.testing.assert_array_equal(w_bucketed, w_mono)
+
+
+@pytest.mark.parametrize("mode,hidden", [
+    ("sketch", 40),             # tiled: d=282 splits at the 128-block cut
+    ("sketch_global", 6),       # global: align=1, real 4-way split
+    ("sketch_quarantine", 40),  # per-worker path, sketch after aggregate
+])
+def test_sketch_modes_bucketed_tight_tolerance(mode, hidden):
+    # per-table-cell sums associate bucket-by-bucket instead of strictly
+    # block-by-block — exact-arithmetic equal, f32-tight here (module
+    # docstring / ops/countsketch.sketch_range)
+    w_mono = _run_rounds(MODE_CFGS[mode], hidden=hidden, num_buckets=0)
+    w_bucketed = _run_rounds(MODE_CFGS[mode], hidden=hidden, num_buckets=4)
+    np.testing.assert_allclose(w_bucketed, w_mono, rtol=2e-6, atol=1e-6)
+
+
+def test_grad_buckets_one_is_the_pre_bucketing_program():
+    """--grad_buckets 1 (the default) must be the monolithic program
+    ITSELF, not an equivalent one: the learner's plan is None, so
+    build_round_step takes the literal pre-bucketing code path and the
+    trajectory is bitwise identical by construction."""
+    from commefficient_tpu.federated.api import FedLearner
+    from commefficient_tpu.federated.losses import make_cv_loss
+    from commefficient_tpu.models import TinyMLP
+
+    model = TinyMLP(num_classes=2, hidden=4)
+
+    def make(grad_buckets):
+        cfg = FedConfig(weight_decay=0, num_workers=3, num_clients=4,
+                        lr_scale=0.05, grad_buckets=grad_buckets,
+                        **MODE_CFGS["local_topk"])
+        return FedLearner(model, cfg, make_cv_loss(model), None,
+                          jax.random.PRNGKey(1),
+                          np.zeros((1, 8), np.float32))
+
+    rng = np.random.RandomState(0)
+    Xb = rng.randn(3, 4, 8).astype(np.float32)
+    yb = rng.randint(0, 2, (3, 4)).astype(np.int32)
+    mask = np.ones((3, 4), np.float32)
+
+    ln_default, ln_k1 = make(1), make(1)
+    assert ln_default.grad_buckets is None and ln_k1.grad_buckets is None
+    ln_k4 = make(4)
+    assert ln_k4.grad_buckets is not None
+    assert ln_k4.grad_buckets.num_buckets >= 2
+
+    for ln in (ln_default, ln_k1, ln_k4):
+        for r in range(2):
+            ln.train_round([0, 1, 2], (Xb, yb), mask)
+    np.testing.assert_array_equal(np.asarray(ln_default.state.weights),
+                                  np.asarray(ln_k1.state.weights))
+    # local_topk is a dense transmit: the bucketed learner is bitwise too
+    np.testing.assert_array_equal(np.asarray(ln_k4.state.weights),
+                                  np.asarray(ln_default.state.weights))
+
+
+# --------------------------------------------------------------------------
+# structure: the graft-audit target and its mutation
+# --------------------------------------------------------------------------
+
+@pytest.mark.audit
+@pytest.mark.parametrize("variant", ["local_topk", "sketch"])
+def test_bucketed_audit_fails_on_reconcatenated_transmit(variant):
+    """round_bucketed PASSES on the bucketed program and FAILS on the
+    mutated build (same config, transmit re-concatenated into the
+    monolithic compress) — the property that makes the CI gate
+    meaningful: a refactor that undoes the overlap cannot pass."""
+    import commefficient_tpu.analysis as A
+
+    good = A.round_bucketed_target(variant).audit(with_retrace=False)
+    assert good.ok, [str(v) for r in good.rule_reports
+                     for v in r.violations]
+
+    mutated = A.round_bucketed_target(variant, mutate=True).audit(
+        with_retrace=False)
+    assert not mutated.ok
+    msgs = " | ".join(str(v) for r in mutated.rule_reports
+                      for v in r.violations)
+    assert "monolithic" in msgs
+    assert "re-concatenated" in msgs
