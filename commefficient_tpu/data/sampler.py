@@ -6,6 +6,12 @@ replacement and take up to ``local_batch_size`` items from each
 
 Yields structured rounds instead of flat index arrays: a list of
 (client_id, flat_indices) pairs, which is what the fixed-shape batcher needs.
+
+Preemption support (docs/ROBUSTNESS.md "Preemption"): ``epoch(skip=k)``
+replays the first ``k`` rounds' RNG draws and exhaustion bookkeeping without
+materializing them, and ``cursor()``/``restore_cursor()`` serialize the
+generator state so a killed run resumes on the exact round sequence the
+uninterrupted run would have produced.
 """
 
 from __future__ import annotations
@@ -22,8 +28,24 @@ class FedSampler:
         self.num_workers = num_workers
         self.local_batch_size = local_batch_size
         self.rng = np.random.RandomState(seed)
+        # rng state as of the most recent epoch() call — what a mid-epoch
+        # checkpoint must record, because the epoch's permutation and all
+        # its selection draws derive from it (the live generator has
+        # already consumed prefetch-lookahead rounds the trainer hasn't
+        # seen yet, so its CURRENT state is the wrong thing to save)
+        self._epoch_start_state = self.rng.get_state()
+        self.epochs_started = 0
 
-    def epoch(self) -> Iterator[List[Tuple[int, np.ndarray]]]:
+    def epoch(self, skip: int = 0) -> Iterator[List[Tuple[int, np.ndarray]]]:
+        """One epoch of rounds. ``skip`` fast-forwards past the first
+        ``skip`` rounds — identical RNG draws and per-client exhaustion
+        updates, no yields — so a resumed epoch continues the interrupted
+        one's exact sequence."""
+        self._epoch_start_state = self.rng.get_state()
+        self.epochs_started += 1
+        return self._epoch_iter(skip)
+
+    def _epoch_iter(self, skip: int):
         data_per_client = self.dataset.data_per_client
         cumsum = np.hstack([[0], np.cumsum(data_per_client)])
         permuted = np.hstack([
@@ -43,12 +65,34 @@ class FedSampler:
                 take = remaining
             else:
                 take = np.clip(remaining, 0, self.local_batch_size)
-            round_batches = []
-            for w, t in zip(workers, take):
-                s = cumsum[w] + cur[w]
-                round_batches.append((int(w), permuted[s:s + t]))
-            yield round_batches
+            if skip > 0:
+                skip -= 1
+            else:
+                round_batches = []
+                for w, t in zip(workers, take):
+                    s = cumsum[w] + cur[w]
+                    round_batches.append((int(w), permuted[s:s + t]))
+                yield round_batches
             cur[workers] += take
+
+    def cursor(self, in_epoch: bool) -> dict:
+        """Serializable RNG position. ``in_epoch=True`` records the state
+        the CURRENT epoch started from (resume = replay that epoch with
+        ``skip``); ``in_epoch=False`` records the live state at an epoch
+        boundary (resume = start the next epoch fresh)."""
+        state = (self._epoch_start_state if in_epoch
+                 else self.rng.get_state())
+        kind, keys, pos, has_gauss, cached = state
+        return {"rng": [kind, [int(x) for x in keys], int(pos),
+                        int(has_gauss), float(cached)],
+                "epochs_started": self.epochs_started}
+
+    def restore_cursor(self, cur: dict, in_epoch: bool) -> None:
+        kind, keys, pos, has_gauss, cached = cur["rng"]
+        self.rng.set_state((kind, np.asarray(keys, np.uint32), pos,
+                            has_gauss, cached))
+        # an in-epoch resume re-calls epoch(), which re-increments
+        self.epochs_started = cur["epochs_started"] - (1 if in_epoch else 0)
 
     def steps_per_epoch(self) -> int:
         """Matches steps_per_epoch (reference utils.py:315-321)."""

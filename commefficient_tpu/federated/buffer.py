@@ -635,6 +635,30 @@ class BufferedFedLearner(FedLearner):
         raw["lr"] = lr
         return raw
 
+    def event_cursor(self) -> dict:
+        """Host event-loop position for checkpointing. In-flight heap
+        entries and any partial buffer are deliberately transient (see
+        utils/checkpoint.py: contributions are never saved) — the cursor
+        is the dispatch clock the fault model's pure-function schedule
+        replays from."""
+        return {"cohorts_done": self.cohorts_done,
+                "applies_done": self.applies_done,
+                "sim_time": float(self.sim_time),
+                "seq": self._seq}
+
+    def restore_event_cursor(self, cur: dict) -> None:
+        self.cohorts_done = int(cur["cohorts_done"])
+        self.applies_done = int(cur["applies_done"])
+        self.sim_time = float(cur["sim_time"])
+        self._seq = int(cur["seq"])
+        # a resume starts with an empty buffer and no in-flight arrivals
+        # (checkpoint saves happen after flush points in the training
+        # loop; anything still heaped at a hard kill is lost by contract)
+        self._events = []
+        self._buf_count = 0
+        self._last_lr_in = None
+        self._apply_rng = None
+
     def flush_faults(self, apply_partial: bool = True):
         """Drain every in-flight arrival and (optionally) apply whatever
         partial buffer remains — end-of-training barrier, the one place

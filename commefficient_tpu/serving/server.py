@@ -178,3 +178,29 @@ class ContinuousBatchingServer:
             if max_steps <= 0:
                 raise RuntimeError("serving loop exceeded max_steps")
         return replies
+
+    def drain(self, max_steps: int = 100_000):
+        """Graceful preemption shutdown: stop admissions, finish the
+        in-flight slots, and hand back what never started.
+
+        Returns ``(replies, leftovers)``: ``replies`` maps rid ->
+        reply tokens for every request that had already been admitted
+        (their decode completes here — admitted work is never thrown
+        away); ``leftovers`` is the undispatched queue in submission
+        order, as ``(ids, types, reply_type, max_new)`` tuples a
+        replacement server can re-``submit`` verbatim. Because slot rows
+        decode independently and greedy sampling is deterministic,
+        resubmitting a leftover on a fresh server over the same
+        checkpoint yields the reply this server would have produced
+        (tests/test_decode.py)."""
+        leftovers = [(list(r.ids), list(r.types), r.reply_type, r.max_new)
+                     for r in self._queue]
+        self._queue.clear()
+        replies: Dict[int, List[int]] = {}
+        while any(r is not None for r in self._slot_req):
+            for rid, toks in self.step():
+                replies[rid] = toks
+            max_steps -= 1
+            if max_steps <= 0:
+                raise RuntimeError("drain exceeded max_steps")
+        return replies, leftovers

@@ -45,6 +45,19 @@ def build_parser(default_lr: float = 0.4) -> argparse.ArgumentParser:
                         "(ref cv_train.py:91)")
     p.add_argument("--checkpoint", action="store_true", dest="do_checkpoint")
     p.add_argument("--checkpoint_path", default="./checkpoint")
+    p.add_argument("--checkpoint_every_rounds", type=int, default=0,
+                   help="write a crash-consistent step checkpoint every N "
+                        "rounds (0 = off) under --checkpoint_path, with a "
+                        ".latest pointer and bounded retention; also arms "
+                        "the SIGTERM/SIGINT finish-round-save-exit handler "
+                        "(docs/ROBUSTNESS.md 'Preemption')")
+    p.add_argument("--resume", default=None, metavar="auto|PATH",
+                   help="resume training from a checkpoint: 'auto' picks "
+                        "the newest valid checkpoint under "
+                        "--checkpoint_path (fresh start if none), a path "
+                        "names a file or directory. Restores learner "
+                        "state, data-order cursor, and LR-schedule step; "
+                        "a config-fingerprint mismatch fails loudly")
     p.add_argument("--finetune", action="store_true", dest="do_finetune")
     p.add_argument("--finetune_path", default="./finetune")
     # compression

@@ -138,6 +138,19 @@ def train(args, mesh=None, max_rounds=None, log=True):
     learner = build_learner(args, cols0[0][0][:1], num_classes, channels,
                             mesh=mesh)
 
+    # periodic crash-consistent checkpoints + resume (the probe round
+    # above runs before resume() so its sampler/aug draws — identical in
+    # every launch — are overwritten by the restored cursor)
+    from commefficient_tpu.training.preempt import (PreemptionGuard,
+                                                    TrainCheckpointer)
+    ckpt = TrainCheckpointer(
+        args, learner, batcher, entry="cv", log=log,
+        meta={"model": args.model, "num_classes": num_classes,
+              "do_batchnorm": args.do_batchnorm})
+    cursor = ckpt.resume()
+    start_epoch = cursor["epoch"] if cursor else 0
+    skip0 = cursor["rounds_in_epoch"] if cursor else 0
+
     table = TableLogger() if log else None
     writer = None
     if getattr(args, "use_tensorboard", False):
@@ -145,7 +158,7 @@ def train(args, mesh=None, max_rounds=None, log=True):
         writer = ScalarWriter(make_logdir(args))
     timer = Timer()
     spe = batcher.steps_per_epoch()
-    total_rounds = 0
+    total_rounds = cursor["total_rounds"] if cursor else 0
     if getattr(args, "eval_before_start", False):
         # baseline validation at init (ref cv_train.py:91-103). Snapshot
         # the learner rng: evaluate() splits the shared stream, and a
@@ -159,9 +172,11 @@ def train(args, mesh=None, max_rounds=None, log=True):
         if writer:
             writer.add_scalar("test_loss", val0["loss"], 0)
             writer.add_scalar("test_acc", float(val0["metrics"][0]), 0)
+    guard = PreemptionGuard(enabled=ckpt.active, log=log)
     try:
+        guard.__enter__()
         n_epochs = int(math.ceil(args.num_epochs))
-        for epoch in range(n_epochs):
+        for epoch in range(start_epoch, n_epochs):
             # fractional num_epochs truncates the LAST epoch's round count
             # (ref cv_train.py:100-106, 194-196: only epoch_fraction of the
             # final epoch's batches run); whole epochs run the full spe
@@ -169,7 +184,11 @@ def train(args, mesh=None, max_rounds=None, log=True):
                               if epoch == n_epochs - 1 else 1.0)
             rounds_cap = (spe if epoch_fraction >= 1
                           else max(1, int(round(spe * epoch_fraction))))
-            rounds_in_epoch = 0
+            # a resumed mid-epoch run replays the first `skip` rounds'
+            # RNG/data draws without training them (batcher.epoch(skip))
+            skip = skip0 if epoch == start_epoch else 0
+            rounds_in_epoch = skip
+            pending_boundary_save = False
             epoch_metrics = []
             # one-round software pipeline (RoundPipeline): metric sync
             # overlaps the next round's device compute, so the loop runs
@@ -227,7 +246,8 @@ def train(args, mesh=None, max_rounds=None, log=True):
                 return bad
 
             for (ids, cols, mask), nxt in with_lookahead(
-                    device_prefetch(batcher.epoch(), shardings=batch_sh)):
+                    device_prefetch(batcher.epoch(skip=skip),
+                                    shardings=batch_sh)):
                 frac = total_rounds / max(spe, 1)
                 if window is not None:
                     total_rounds += 1
@@ -242,8 +262,38 @@ def train(args, mesh=None, max_rounds=None, log=True):
                     rounds_in_epoch += 1
                     if bad := check(pipe.push(raw)):
                         return abort(bad)
-                if (args.do_test or rounds_in_epoch >= rounds_cap
-                        or (max_rounds and total_rounds >= max_rounds)):
+                # nxt is None == the sampler just exhausted: this round is
+                # the epoch's last even if the spe-derived cap disagrees
+                # (steps_per_epoch is an estimate; the loop runs the data
+                # out), so the save must defer to the boundary path too
+                at_boundary = (args.do_test or rounds_in_epoch >= rounds_cap
+                               or (max_rounds and total_rounds >= max_rounds)
+                               or nxt is None)
+                if guard.triggered or ckpt.due(total_rounds):
+                    if at_boundary:
+                        # defer to after the epoch's flush + eval below: a
+                        # save here would record a sampler cursor the
+                        # resumed epoch could never finish consuming (the
+                        # prefetch lookahead's draws would be lost) and the
+                        # eval rng splits would be drawn twice on resume
+                        pending_boundary_save = True
+                    else:
+                        # settle the in-flight round first — rounds_done
+                        # and the byte totals only advance in
+                        # finalize_round_metrics (the RoundPipeline's
+                        # one-round metric lag)
+                        if bad := (check_all(window.flush())
+                                   if window is not None
+                                   else check(pipe.flush())):
+                            return abort(bad)
+                        learner.flush_offload()
+                        ckpt.save(epoch, rounds_in_epoch, total_rounds,
+                                  in_epoch=True)
+                        if guard.triggered:
+                            return learner, {"preempted": True,
+                                             "epoch": epoch + 1,
+                                             "rounds": total_rounds}
+                if at_boundary:
                     break
             # epoch boundary: settle offloaded host rows (pending lazy
             # writebacks + any gather-ahead for a round that never ran)
@@ -277,9 +327,20 @@ def train(args, mesh=None, max_rounds=None, log=True):
                 for tag in ("train_loss", "train_acc", "train_time",
                             "test_loss", "test_acc", "test_time", "lr"):
                     writer.add_scalar(tag, row[tag], epoch + 1)
+            if pending_boundary_save or guard.triggered:
+                last = (epoch + 1 >= n_epochs or args.do_test
+                        or (max_rounds and total_rounds >= max_rounds))
+                if not last:
+                    # boundary save: cursor points at the NEXT epoch's
+                    # start, with the sampler/aug/learner rng all past
+                    # this epoch's tail draws and eval splits
+                    ckpt.save(epoch + 1, 0, total_rounds, in_epoch=False)
+                    if guard.triggered:
+                        return learner, dict(row, preempted=True)
             if args.do_test or (max_rounds and total_rounds >= max_rounds):
                 break
     finally:
+        guard.__exit__()
         if writer:
             writer.close()
 

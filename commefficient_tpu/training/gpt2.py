@@ -303,13 +303,23 @@ def train(args, mesh=None, max_rounds=None, log=True):
                           init_params=init_params, param_specs=param_specs,
                           **learner_extra)
 
+    # periodic crash-consistent checkpoints + resume (training/preempt.py;
+    # this entrypoint never materialized a probe round, so the restored
+    # cursor is the only thing that touches the sampler before the loop)
+    from commefficient_tpu.training.preempt import (PreemptionGuard,
+                                                    TrainCheckpointer)
+    ckpt = TrainCheckpointer(args, learner, batcher, entry="gpt2", log=log)
+    cursor = ckpt.resume()
+    start_epoch = cursor["epoch"] if cursor else 0
+    skip0 = cursor["rounds_in_epoch"] if cursor else 0
+
     table = TableLogger() if log else None
     writer = None
     if getattr(args, "use_tensorboard", False):
         from commefficient_tpu.utils.logging import ScalarWriter, make_logdir
         writer = ScalarWriter(make_logdir(args))
     timer = Timer()
-    total_rounds = 0
+    total_rounds = cursor["total_rounds"] if cursor else 0
     row = {}
     if getattr(args, "eval_before_start", False):
         # baseline validation at init (ref cv_train.py:91-103); rng
@@ -327,8 +337,13 @@ def train(args, mesh=None, max_rounds=None, log=True):
                   f"ppl={float(np.exp(min(nll0, 20.0))):.2f}")
         if writer:
             writer.add_scalar("nll", nll0, 0)
+    guard = PreemptionGuard(enabled=ckpt.active, log=log)
     try:
-        for epoch in range(int(math.ceil(args.num_epochs))):
+        guard.__enter__()
+        for epoch in range(start_epoch, int(math.ceil(args.num_epochs))):
+            skip = skip0 if epoch == start_epoch else 0
+            rounds_in_epoch = skip
+            pending_boundary_save = False
             losses = []
             # one-round pipeline (RoundPipeline; see training/cv.py): sync
             # for round r-1 overlaps round r's compute; NaN abort lags one
@@ -364,10 +379,12 @@ def train(args, mesh=None, max_rounds=None, log=True):
                 return bad
 
             for (ids, cols, mask), nxt in with_lookahead(device_prefetch(
-                    batcher.epoch(), shardings=learner.batch_shardings)):
+                    batcher.epoch(skip=skip),
+                    shardings=learner.batch_shardings)):
                 if window is not None:
                     out_w = window.push(ids, cols, mask, total_rounds)
                     total_rounds += 1
+                    rounds_in_epoch += 1
                     if check_all(out_w):
                         print("NaN loss; aborting")
                         learner.flush_offload()
@@ -377,10 +394,32 @@ def train(args, mesh=None, max_rounds=None, log=True):
                         ids, cols, mask, epoch_frac=total_rounds,
                         next_client_ids=nxt[0] if nxt is not None else None)
                     total_rounds += 1
+                    rounds_in_epoch += 1
                     if check(pipe.push(raw)):
                         print("NaN loss; aborting")
                         learner.flush_offload()
                         return learner, {"aborted": True}
+                at_boundary = (args.do_test or nxt is None
+                               or (max_rounds and total_rounds >= max_rounds))
+                if guard.triggered or ckpt.due(total_rounds):
+                    # an epoch's last round (nxt is None == the sampler
+                    # just exhausted) defers its save to the boundary path
+                    # below — see training/cv.py for the cursor rationale
+                    if at_boundary:
+                        pending_boundary_save = True
+                    else:
+                        if (check_all(window.flush()) if window is not None
+                                else check(pipe.flush())):
+                            print("NaN loss; aborting")
+                            learner.flush_offload()
+                            return learner, {"aborted": True}
+                        learner.flush_offload()
+                        ckpt.save(epoch, rounds_in_epoch, total_rounds,
+                                  in_epoch=True)
+                        if guard.triggered:
+                            return learner, {"preempted": True,
+                                             "epoch": epoch + 1,
+                                             "rounds": total_rounds}
                 if args.do_test or (max_rounds and total_rounds >= max_rounds):
                     break
             # epoch boundary: settle offloaded host rows (pending lazy
@@ -422,9 +461,18 @@ def train(args, mesh=None, max_rounds=None, log=True):
                 # nll/ppl/mc_acc scalars (ref gpt2_train.py:162-164, 233-235)
                 for tag in ("train_loss", "nll", "ppl", "mc_acc", "lr"):
                     writer.add_scalar(tag, row[tag], epoch + 1)
+            if pending_boundary_save or guard.triggered:
+                last = (epoch + 1 >= int(math.ceil(args.num_epochs))
+                        or args.do_test
+                        or (max_rounds and total_rounds >= max_rounds))
+                if not last:
+                    ckpt.save(epoch + 1, 0, total_rounds, in_epoch=False)
+                    if guard.triggered:
+                        return learner, dict(row, preempted=True)
             if args.do_test or (max_rounds and total_rounds >= max_rounds):
                 break
     finally:
+        guard.__exit__()
         if writer:
             writer.close()
 

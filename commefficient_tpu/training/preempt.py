@@ -1,0 +1,200 @@
+"""Preemption tolerance for the training entrypoints (docs/ROBUSTNESS.md
+"Preemption").
+
+Three cooperating pieces, shared by training/cv.py and training/gpt2.py:
+
+- ``PreemptionGuard``: SIGTERM/SIGINT latch for the TPU-preemption-notice
+  path. First signal sets ``triggered`` — the training loop finishes the
+  in-flight round, saves, and exits cleanly; a second signal aborts
+  immediately.
+- ``config_fingerprint``: the trajectory-relevant subset of the parsed
+  args. Stored in every periodic checkpoint and compared on resume, so
+  resuming under a different config fails loudly instead of silently
+  producing a different trajectory. Deliberately EXCLUDES flags that are
+  trajectory-identical by contract (``--scan_rounds``,
+  ``--client_state_offload``, ``--transfer_guard``, logging/checkpoint
+  plumbing) — those may legitimately differ across the kill/restart.
+- ``TrainCheckpointer``: owns ``--checkpoint_every_rounds`` /
+  ``--resume``. ``save()`` writes a step checkpoint whose cursor captures
+  everything trajectory determinism needs beyond the learner state the
+  checkpoint format already holds: the epoch/round position, the
+  sampler's data-order cursor, and (buffered server) the event-loop
+  cursor. ``resume()`` discovers the latest valid checkpoint (falling
+  back past torn/corrupt files), restores learner + cursors, and returns
+  the position to continue from.
+
+The bitwise-resume contract and its buffered-mode scope are documented in
+docs/ROBUSTNESS.md and enforced by tests/test_preemption.py.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+
+from commefficient_tpu.utils.checkpoint import (find_latest_checkpoint,
+                                                load_checkpoint,
+                                                save_checkpoint)
+
+#: args fields that determine the training trajectory. Anything here that
+#: differs between the checkpointing run and the resuming run is a loud
+#: error; fields absent from an entrypoint's parser fingerprint as None.
+_FINGERPRINT_FIELDS = (
+    # task / model / data
+    "seed", "mode", "model", "dataset_name", "do_iid", "num_clients",
+    "num_workers", "local_batch_size", "valid_batch_size",
+    "microbatch_size", "do_batchnorm", "compute_dtype", "do_test",
+    "num_epochs", "do_finetune",
+    # optimizer / schedule
+    "lr_scale", "pivot_epoch", "scalar_lr_factor", "local_momentum",
+    "virtual_momentum", "weight_decay", "max_grad_norm", "nan_threshold",
+    "num_fedavg_epochs", "fedavg_batch_size", "fedavg_lr_decay",
+    # compression
+    "k", "num_cols", "num_rows", "num_blocks", "sketch_scheme",
+    "grad_buckets", "error_type", "do_topk_down", "topk_approx_recall",
+    # server / faults / quarantine
+    "server_mode", "buffer_m", "staleness_alpha", "client_quarantine",
+    "quarantine_rounds", "fault_seed", "fault_dropout_prob",
+    "fault_crash_prob", "straggler_frac", "straggler_mult", "base_latency",
+    "latency_sigma", "dispatch_interval",
+    # DP
+    "do_dp", "dp_mode", "l2_norm_clip", "noise_multiplier",
+    # gpt2-only (None for cv runs)
+    "model_checkpoint", "num_candidates", "max_history", "lm_coef",
+    "mc_coef", "personality_permutations", "dropout_impl", "attn_dropout",
+)
+
+
+def config_fingerprint(args, entry: str) -> dict:
+    fp = {"entry": entry}
+    for f in _FINGERPRINT_FIELDS:
+        v = getattr(args, f, None)
+        fp[f] = v if (v is None or isinstance(v, (bool, int, float, str))
+                      ) else str(v)
+    return fp
+
+
+class PreemptionGuard:
+    """Latch SIGTERM/SIGINT so the training loop can finish the in-flight
+    round, checkpoint, and exit — instead of dying mid-round. Installed
+    only when periodic checkpointing is active (there is nothing graceful
+    to do without a save path). Restores the previous handlers on exit."""
+
+    def __init__(self, enabled: bool = True, log: bool = True):
+        self.enabled = enabled
+        self.log = log
+        self.triggered = False
+        self._old = {}
+
+    def __enter__(self):
+        if self.enabled:
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                try:
+                    self._old[sig] = signal.signal(sig, self._handle)
+                except ValueError:
+                    # not the main thread (e.g. an in-process test driver)
+                    pass
+        return self
+
+    def _handle(self, signum, frame):
+        if self.triggered:
+            # second notice: the operator means it
+            raise KeyboardInterrupt(f"second signal {signum} during "
+                                    f"graceful preemption shutdown")
+        self.triggered = True
+        if self.log:
+            print(f"signal {signum}: finishing in-flight round, "
+                  f"checkpointing, exiting", flush=True)
+
+    def __exit__(self, *exc):
+        for sig, h in self._old.items():
+            signal.signal(sig, h)
+        return False
+
+
+class TrainCheckpointer:
+    """Periodic/preemption checkpointing + resume for one training run."""
+
+    def __init__(self, args, learner, batcher, entry: str, meta: dict = None,
+                 log: bool = True):
+        self.every = int(getattr(args, "checkpoint_every_rounds", 0) or 0)
+        self.resume_spec = getattr(args, "resume", None)
+        self.path = args.checkpoint_path
+        self.name = args.model
+        self.learner = learner
+        self.batcher = batcher
+        self.entry = entry
+        self.meta = meta
+        self.log = log
+        self.fingerprint = config_fingerprint(args, entry)
+
+    @property
+    def active(self) -> bool:
+        return self.every > 0
+
+    def due(self, total_rounds: int) -> bool:
+        return self.active and total_rounds % self.every == 0
+
+    def save(self, epoch: int, rounds_in_epoch: int, total_rounds: int,
+             in_epoch: bool) -> str:
+        """The caller must have settled the round pipeline / scan window
+        first (``learner.rounds_done`` and the byte totals only advance in
+        ``finalize_round_metrics``); ``save_checkpoint`` itself drains the
+        offload pipeline."""
+        cursor = {"entry": self.entry, "epoch": epoch,
+                  "rounds_in_epoch": rounds_in_epoch,
+                  "total_rounds": total_rounds, "in_epoch": in_epoch,
+                  "data": self.batcher.cursor(in_epoch)}
+        if hasattr(self.learner, "event_cursor"):
+            cursor["buffered"] = self.learner.event_cursor()
+        fn = save_checkpoint(self.path, self.learner, self.name,
+                             meta=self.meta, step=total_rounds,
+                             cursor=cursor, fingerprint=self.fingerprint)
+        if self.log:
+            print(f"checkpoint: {fn} (round {total_rounds})", flush=True)
+        return fn
+
+    def resume(self):
+        """Restore from ``--resume`` and return the cursor dict, or None
+        for a fresh start. ``--resume auto`` with no checkpoint on disk is
+        a fresh start (first launch of an auto-restarting job); an
+        explicit path that doesn't resolve is an error."""
+        spec = self.resume_spec
+        if not spec:
+            return None
+        if spec == "auto":
+            fn = find_latest_checkpoint(self.path, self.name)
+            if fn is None:
+                if self.log:
+                    print(f"--resume auto: no valid checkpoint under "
+                          f"{self.path!r}; starting fresh", flush=True)
+                return None
+        elif os.path.isdir(spec):
+            fn = find_latest_checkpoint(spec, self.name)
+            if fn is None:
+                raise ValueError(f"--resume {spec!r}: no valid checkpoint "
+                                 f"found in directory")
+        else:
+            if not os.path.isfile(spec):
+                raise ValueError(f"--resume {spec!r}: no such file")
+            fn = spec
+        info = load_checkpoint(fn, self.learner,
+                               expect_fingerprint=self.fingerprint)
+        cursor = info["cursor"]
+        if cursor is None:
+            raise ValueError(
+                f"--resume {fn!r}: checkpoint has no training cursor (a "
+                f"pre-v3 or end-of-training export) — it can seed "
+                f"--finetune but cannot bitwise-resume a training run")
+        if cursor.get("entry") != self.entry:
+            raise ValueError(
+                f"--resume {fn!r}: checkpoint was written by the "
+                f"{cursor.get('entry')!r} entrypoint, this is {self.entry!r}")
+        self.batcher.restore_cursor(cursor["data"], cursor["in_epoch"])
+        if "buffered" in cursor and hasattr(self.learner,
+                                            "restore_event_cursor"):
+            self.learner.restore_event_cursor(cursor["buffered"])
+        if self.log:
+            print(f"resumed from {fn}: epoch {cursor['epoch']}, "
+                  f"round {cursor['total_rounds']}", flush=True)
+        return cursor

@@ -8,23 +8,120 @@ vectors — enabling mid-training resume, which the reference cannot do
 
 Format: a single .npz with the flat arrays (portable, no orbax dependency
 at import time).
+
+Format history:
+
+- **v1**: positional ``arr_i`` + scalars.
+- **v2**: adds ``leaf_paths`` (the JSON list of pytree key paths, one per
+  ``arr_i``) so loading aligns arrays to state leaves BY NAME — a missing
+  leaf is backfilled or rejected per-path instead of being inferred from
+  array count + trailing shape, which could silently misalign equal-shaped
+  adjacent leaves (ADVICE r3).
+- **v3** (current): crash-consistency + trajectory determinism. Writes are
+  atomic (temp file + fsync + ``os.replace``); a sha256 ``digest`` over the
+  canonical payload is verified on load, so a torn/truncated file is
+  detected instead of half-restored; periodic saves land as
+  ``{name}_r{step:08d}.npz`` step files behind an atomically-updated
+  ``{name}.latest`` pointer with bounded retention; and the payload gains
+  ``learner_rng`` (the host-side PRNG split chain), ``cursor`` (data-order /
+  epoch / event-loop position, JSON) and ``fingerprint`` (trajectory-
+  relevant config, JSON) so ``--resume`` reproduces the uninterrupted
+  trajectory bitwise. **v2 (and v1) files still load** — the new keys are
+  optional on read, and the digest is only verified when present.
+
+``load_checkpoint`` is transactional: EVERY validation (digest, leaf paths,
+shapes, host-offload rows, config fingerprint) completes before the first
+learner mutation, so a mismatched checkpoint leaves the learner untouched.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
+import re
+import signal
 
 import jax
 import numpy as np
 
 
-#: .npz format history. v2 adds ``leaf_paths`` (the JSON list of pytree key
-#: paths, one per ``arr_i``) so loading aligns arrays to state leaves BY
-#: NAME — a missing leaf is backfilled or rejected per-path instead of
-#: being inferred from array count + trailing shape, which could silently
-#: misalign equal-shaped adjacent leaves (ADVICE r3).
-FORMAT_VERSION = 2
+#: see "Format history" in the module docstring. v3 files remain loadable
+#: by v2 readers only modulo the extra keys; this reader loads v1..v3.
+FORMAT_VERSION = 3
+
+_STEP_RE = re.compile(r"^(?P<name>.+)_r(?P<step>\d{8})\.npz$")
+
+#: keys that describe the checkpoint rather than restorable payload; the
+#: digest covers everything EXCEPT itself.
+_DIGEST_KEY = "digest"
+
+#: module-level save counter for the deterministic crash-injection hook
+#: (tests/test_preemption.py). With COMMEFF_CRASH_POINT=<tag> set, the
+#: COMMEFF_CRASH_AT_SAVE-th (1-based, default 1) save that reaches <tag>
+#: SIGKILLs the process — between the temp-file fsync and os.replace for
+#: tag 'ckpt_before_replace', which is exactly the torn-write window the
+#: atomic rename is supposed to make safe.
+_crash_hits = 0
+
+
+class CheckpointError(ValueError):
+    """A checkpoint file is unreadable, truncated, or fails its digest."""
+
+
+def _crash_point(tag: str) -> None:
+    global _crash_hits
+    if os.environ.get("COMMEFF_CRASH_POINT") != tag:
+        return
+    _crash_hits += 1
+    if _crash_hits >= int(os.environ.get("COMMEFF_CRASH_AT_SAVE", "1")):
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def _payload_digest(payload: dict) -> str:
+    """sha256 over the canonical serialization: sorted keys, each hashed as
+    key + dtype + shape + raw bytes. Stable across npz round-trips because
+    np.load returns exactly the dtype/shape/bytes that were saved."""
+    h = hashlib.sha256()
+    for k in sorted(payload):
+        if k == _DIGEST_KEY:
+            continue
+        a = np.ascontiguousarray(payload[k])
+        h.update(k.encode())
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def _atomic_savez(fn: str, payload: dict) -> None:
+    """Write ``payload`` to ``fn`` crash-consistently: a reader never sees
+    a partial file — either the old content or the new, never a mix."""
+    tmp = fn + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **payload)
+        f.flush()
+        os.fsync(f.fileno())
+    _crash_point("ckpt_before_replace")
+    os.replace(tmp, fn)
+    # fsync the directory so the rename itself survives power loss
+    try:
+        dfd = os.open(os.path.dirname(fn) or ".", os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass
+
+
+def _atomic_write_text(fn: str, text: str) -> None:
+    tmp = fn + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, fn)
 
 
 def _state_arrays(state):
@@ -34,24 +131,46 @@ def _state_arrays(state):
 
 
 def save_checkpoint(path: str, learner, name: str = "model",
-                    meta: dict = None) -> str:
+                    meta: dict = None, *, step: int = None,
+                    cursor: dict = None, fingerprint: dict = None,
+                    keep: int = 3) -> str:
     """``meta``: optional JSON-serializable model description (model name,
-    num_classes, ...) enabling cross-task finetune head swaps."""
+    num_classes, ...) enabling cross-task finetune head swaps.
+
+    With ``step`` (periodic mid-training saves) the file lands as
+    ``{name}_r{step:08d}.npz``, the ``{name}.latest`` pointer is updated
+    atomically, and only the newest ``keep`` step files are retained (the
+    plain ``{name}.npz`` end-of-training export is never pruned). Without
+    ``step`` the historical ``{name}.npz`` single-file behavior is kept.
+
+    ``cursor``/``fingerprint`` are JSON-serialized verbatim; see
+    training/preempt.py for what goes in them.
+    """
     os.makedirs(path, exist_ok=True)
-    fn = os.path.join(path, f"{name}.npz")
+    fn = os.path.join(
+        path, f"{name}.npz" if step is None else f"{name}_r{step:08d}.npz")
     flat, paths, _ = _state_arrays(learner.state)
     # the buffered server's in-flight contribution buffer is deliberately
     # NOT checkpointed: contributions are transient (a resume restarts
     # with an empty buffer and the fault model's schedule), and skipping
     # it keeps buffered checkpoints loadable into sync learners
-    keep = [i for i, p in enumerate(paths) if not p.startswith(".buffer")]
-    flat = [flat[i] for i in keep]
-    paths = [paths[i] for i in keep]
+    keep_idx = [i for i, p in enumerate(paths) if not p.startswith(".buffer")]
+    flat = [flat[i] for i in keep_idx]
+    paths = [paths[i] for i in keep_idx]
     # record which leaf is the global weight vector so finetune can load it
     # without reconstructing this run's FedState treedef (and without
     # storing the dominant array twice)
     widx = next(i for i, x in enumerate(flat) if x is learner.state.weights)
     extra = {"meta": np.asarray(json.dumps(meta))} if meta else {}
+    if cursor is not None:
+        extra["cursor"] = np.asarray(json.dumps(cursor))
+    if fingerprint is not None:
+        extra["fingerprint"] = np.asarray(json.dumps(fingerprint))
+    # the host-side PRNG split chain: one split per round/eval-batch, so
+    # a resumed run continues the exact sequence the uninterrupted run
+    # would have drawn (bitwise-resume contract, docs/ROBUSTNESS.md)
+    if getattr(learner, "rng", None) is not None:
+        extra["learner_rng"] = np.asarray(learner.rng)
     # host-offloaded client state (api.FedLearner.host_clients) is not in
     # the state pytree; drain any pending async writebacks
     # (HostOffloadPipeline), then persist the rows under host_{field} keys
@@ -63,13 +182,111 @@ def save_checkpoint(path: str, learner, name: str = "model",
             if lst is not None:
                 extra[f"host_{field}"] = np.stack(
                     [np.asarray(x) for x in lst])
-    np.savez(fn, rounds_done=learner.rounds_done,
-             total_download_bytes=learner.total_download_bytes,
-             total_upload_bytes=learner.total_upload_bytes,
-             weights_idx=widx, format_version=FORMAT_VERSION,
-             leaf_paths=np.asarray(json.dumps(paths)), **extra,
-             **{f"arr_{i}": np.asarray(x) for i, x in enumerate(flat)})
+    payload = dict(rounds_done=np.asarray(learner.rounds_done),
+                   total_download_bytes=np.asarray(
+                       learner.total_download_bytes),
+                   total_upload_bytes=np.asarray(learner.total_upload_bytes),
+                   weights_idx=np.asarray(widx),
+                   format_version=np.asarray(FORMAT_VERSION),
+                   leaf_paths=np.asarray(json.dumps(paths)), **extra,
+                   **{f"arr_{i}": np.asarray(x) for i, x in enumerate(flat)})
+    payload[_DIGEST_KEY] = np.asarray(_payload_digest(payload))
+    _atomic_savez(fn, payload)
+    if step is not None:
+        _atomic_write_text(os.path.join(path, f"{name}.latest"),
+                           os.path.basename(fn))
+        _prune_step_files(path, name, keep)
     return fn
+
+
+def _step_files(path: str, name: str = None):
+    """(step, filename) pairs of step checkpoints in ``path``, newest
+    first. ``name=None`` matches any prefix."""
+    out = []
+    try:
+        entries = os.listdir(path)
+    except OSError:
+        return out
+    for e in entries:
+        m = _STEP_RE.match(e)
+        if m and (name is None or m.group("name") == name):
+            out.append((int(m.group("step")), e))
+    out.sort(reverse=True)
+    return out
+
+
+def _prune_step_files(path: str, name: str, keep: int) -> None:
+    for _, e in _step_files(path, name)[max(keep, 1):]:
+        try:
+            os.remove(os.path.join(path, e))
+        except OSError:
+            pass
+
+
+def verify_checkpoint(fn: str) -> dict:
+    """Read + integrity-check ``fn`` without touching any learner.
+
+    Returns the full payload as a {key: np.ndarray} dict. Raises
+    ``CheckpointError`` on anything a crash can produce: unreadable /
+    truncated zip, missing members, or a digest mismatch (torn write that
+    somehow got renamed). Pre-v3 files carry no digest and are accepted
+    as long as the zip itself reads cleanly.
+    """
+    try:
+        with np.load(fn, allow_pickle=False) as z:
+            payload = {k: z[k] for k in z.files}
+    except Exception as e:  # zipfile/np raise a zoo of types on truncation
+        raise CheckpointError(f"checkpoint {fn} is unreadable: {e}") from e
+    if _DIGEST_KEY in payload:
+        want = str(payload[_DIGEST_KEY])
+        got = _payload_digest(payload)
+        if want != got:
+            raise CheckpointError(
+                f"checkpoint {fn} fails digest verification "
+                f"(stored {want[:12]}…, computed {got[:12]}…) — torn or "
+                f"corrupted write")
+    return payload
+
+
+def find_latest_checkpoint(path: str, name: str = None):
+    """Newest VALID checkpoint file under ``path``, or None.
+
+    Tries the ``.latest`` pointer first, then every step file newest-first
+    (so a truncated/corrupt newest falls back to the previous good one),
+    then a plain ``{name}.npz`` end-of-training export. Each candidate is
+    digest-verified before being returned.
+    """
+    candidates = []
+    try:
+        entries = sorted(os.listdir(path))
+    except OSError:
+        return None
+    for e in entries:
+        if e.endswith(".latest") and (name is None or
+                                      e == f"{name}.latest"):
+            try:
+                with open(os.path.join(path, e)) as f:
+                    candidates.append(f.read().strip())
+            except OSError:
+                pass
+    candidates += [e for _, e in _step_files(path, name)]
+    candidates += [e for e in entries
+                   if e.endswith(".npz") and not _STEP_RE.match(e)
+                   and (name is None or e == f"{name}.npz")]
+    seen = set()
+    for e in candidates:
+        if not e or e in seen:
+            continue
+        seen.add(e)
+        fn = os.path.join(path, e)
+        if not os.path.isfile(fn):
+            continue
+        try:
+            verify_checkpoint(fn)
+        except CheckpointError:
+            continue
+        return fn
+    return None
 
 
 #: leaves that may legitimately be absent from an older checkpoint, and the
@@ -85,76 +302,110 @@ _BACKFILL = {
 }
 
 
-def load_checkpoint(fn: str, learner) -> None:
-    """Restore in place; the learner must be built with the same config."""
+def load_checkpoint(fn: str, learner, expect_fingerprint: dict = None):
+    """Restore in place; the learner must be built with the same config.
+
+    Transactional: all validation (digest, leaf alignment, shapes,
+    host-offload rows, fingerprint) happens BEFORE any learner mutation,
+    so a rejected checkpoint leaves the learner exactly as it was.
+
+    Returns ``{"cursor", "meta", "fingerprint", "rounds_done"}`` with the
+    JSON fields parsed (None when absent — e.g. any pre-v3 file).
+    """
     # settle the offload pipeline BEFORE overwriting host rows: a pending
     # writeback or gather-ahead buffer landing after the restore would
-    # resurrect pre-load rows
+    # resurrect pre-load rows. (Read-only on learner state: flush only
+    # completes writebacks the learner already issued.)
     if hasattr(learner, "flush_offload"):
         learner.flush_offload()
-    with np.load(fn) as z:
-        flat, paths, treedef = _state_arrays(learner.state)
-        n_saved = sum(1 for k in z.files if k.startswith("arr_"))
-        if "leaf_paths" in z.files:
-            # v2: align saved arrays to current leaves by key path
-            saved_paths = json.loads(str(z["leaf_paths"]))
-            by_path = {p: z[f"arr_{i}"] for i, p in enumerate(saved_paths)}
-            unknown = set(saved_paths) - set(paths)
-            if unknown:
+    z = verify_checkpoint(fn)
+    flat, paths, treedef = _state_arrays(learner.state)
+    n_saved = sum(1 for k in z if k.startswith("arr_"))
+    if "leaf_paths" in z:
+        # v2+: align saved arrays to current leaves by key path
+        saved_paths = json.loads(str(z["leaf_paths"]))
+        by_path = {p: z[f"arr_{i}"] for i, p in enumerate(saved_paths)}
+        unknown = set(saved_paths) - set(paths)
+        if unknown:
+            raise ValueError(
+                f"checkpoint {fn} has state leaves {sorted(unknown)} the "
+                f"learner doesn't — config/mode mismatch")
+        restored = []
+        for p, cur in zip(paths, flat):
+            if p.startswith(".buffer"):
+                # never saved (see save_checkpoint): a buffered
+                # learner resumes with its current (empty) buffer
+                restored.append(cur)
+            elif p in by_path:
+                restored.append(by_path[p])
+            elif p in _BACKFILL:
+                restored.append(_BACKFILL[p](cur))
+            else:
                 raise ValueError(
-                    f"checkpoint {fn} has state leaves {sorted(unknown)} the "
-                    f"learner doesn't — config/mode mismatch")
-            restored = []
-            for p, cur in zip(paths, flat):
-                if p.startswith(".buffer"):
-                    # never saved (see save_checkpoint): a buffered
-                    # learner resumes with its current (empty) buffer
-                    restored.append(cur)
-                elif p in by_path:
-                    restored.append(by_path[p])
-                elif p in _BACKFILL:
-                    restored.append(_BACKFILL[p](cur))
-                else:
-                    raise ValueError(
-                        f"checkpoint {fn} is missing state leaf {p!r} — "
-                        f"config/mode mismatch")
-        else:
-            # v1 (no leaf list): positional with the historical trailing-
-            # scalar heuristic for pre-NaN-guard files
-            restored = [z[f"arr_{i}"] for i in range(n_saved)]
-            if n_saved == len(flat) - 1 and flat[-1].shape == ():
-                restored.append(np.zeros((), bool))
-            elif n_saved != len(flat):
+                    f"checkpoint {fn} is missing state leaf {p!r} — "
+                    f"config/mode mismatch")
+    else:
+        # v1 (no leaf list): positional with the historical trailing-
+        # scalar heuristic for pre-NaN-guard files
+        restored = [z[f"arr_{i}"] for i in range(n_saved)]
+        if n_saved == len(flat) - 1 and flat[-1].shape == ():
+            restored.append(np.zeros((), bool))
+        elif n_saved != len(flat):
+            raise ValueError(
+                f"checkpoint {fn} has {n_saved} state arrays, learner "
+                f"expects {len(flat)} — config/mode mismatch")
+    for i, (cur, new) in enumerate(zip(flat, restored)):
+        if tuple(cur.shape) != tuple(new.shape):
+            raise ValueError(
+                f"checkpoint {fn} array {i} ({paths[i]}) has shape "
+                f"{new.shape}, learner expects {cur.shape} — "
+                f"model/config mismatch")
+    # host-offload rows: validate fully before the state swap below
+    host = getattr(learner, "host_clients", None)
+    host_pending = []
+    if host:
+        for field, lst in host.items():
+            if lst is None:
+                continue
+            key = f"host_{field}"
+            if key not in z:
                 raise ValueError(
-                    f"checkpoint {fn} has {n_saved} state arrays, learner "
-                    f"expects {len(flat)} — config/mode mismatch")
-        for i, (cur, new) in enumerate(zip(flat, restored)):
-            if tuple(cur.shape) != tuple(new.shape):
+                    f"checkpoint {fn} is missing offloaded client "
+                    f"rows {key!r} — it was saved without "
+                    f"client_state_offload (config mismatch)")
+            arr = z[key]
+            want = (len(lst),) + tuple(np.shape(lst[0]))
+            if tuple(arr.shape) != want:
                 raise ValueError(
-                    f"checkpoint {fn} array {i} ({paths[i]}) has shape "
-                    f"{new.shape}, learner expects {cur.shape} — "
-                    f"model/config mismatch")
-        learner.state = jax.tree_util.tree_unflatten(
-            treedef, [jax.numpy.asarray(x) for x in restored])
-        host = getattr(learner, "host_clients", None)
-        if host:
-            for field, lst in host.items():
-                if lst is None:
-                    continue
-                key = f"host_{field}"
-                if key not in z.files:
-                    raise ValueError(
-                        f"checkpoint {fn} is missing offloaded client "
-                        f"rows {key!r} — it was saved without "
-                        f"client_state_offload (config mismatch)")
-                arr = z[key]
-                want = (len(lst),) + tuple(np.shape(lst[0]))
-                if tuple(arr.shape) != want:
-                    raise ValueError(
-                        f"checkpoint {fn} {key} has shape {arr.shape}, "
-                        f"learner expects {want} — config mismatch")
-                for i in range(len(lst)):
-                    lst[i] = learner._to_host(arr[i])
-        learner.rounds_done = int(z["rounds_done"])
-        learner.total_download_bytes = float(z["total_download_bytes"])
-        learner.total_upload_bytes = float(z["total_upload_bytes"])
+                    f"checkpoint {fn} {key} has shape {arr.shape}, "
+                    f"learner expects {want} — config mismatch")
+            host_pending.append((lst, arr))
+    fingerprint = (json.loads(str(z["fingerprint"]))
+                   if "fingerprint" in z else None)
+    if expect_fingerprint is not None and fingerprint is not None:
+        bad = sorted(k for k in set(fingerprint) | set(expect_fingerprint)
+                     if fingerprint.get(k) != expect_fingerprint.get(k))
+        if bad:
+            detail = ", ".join(
+                f"{k}: checkpoint={fingerprint.get(k)!r} "
+                f"run={expect_fingerprint.get(k)!r}" for k in bad)
+            raise ValueError(
+                f"checkpoint {fn} was written by a run with a different "
+                f"config — resuming would silently change the trajectory. "
+                f"Mismatched: {detail}")
+    # ---- all validation passed; mutate ---------------------------------
+    learner.state = jax.tree_util.tree_unflatten(
+        treedef, [jax.numpy.asarray(x) for x in restored])
+    for lst, arr in host_pending:
+        for i in range(len(lst)):
+            lst[i] = learner._to_host(arr[i])
+    learner.rounds_done = int(z["rounds_done"])
+    learner.total_download_bytes = float(z["total_download_bytes"])
+    learner.total_upload_bytes = float(z["total_upload_bytes"])
+    if "learner_rng" in z and getattr(learner, "rng", None) is not None:
+        learner.rng = jax.numpy.asarray(z["learner_rng"])
+    return {"cursor": json.loads(str(z["cursor"])) if "cursor" in z
+            else None,
+            "meta": json.loads(str(z["meta"])) if "meta" in z else None,
+            "fingerprint": fingerprint,
+            "rounds_done": int(z["rounds_done"])}

@@ -70,16 +70,29 @@ def load_pretrained_for_finetune(module, rng, sample_input,
     leaves are restored per-path; only head-shaped leaves may differ.
     """
     if os.path.isdir(checkpoint_file):
+        from commefficient_tpu.utils.checkpoint import _STEP_RE
+        # step files ({name}_rNNNNNNNN.npz, --checkpoint_every_rounds) are
+        # mid-training saves behind a .latest pointer; only plain exports
+        # count as THE checkpoint of the directory. Several distinct
+        # exports is still ambiguous; a retention window is not.
         cands = sorted(f for f in os.listdir(checkpoint_file)
-                       if f.endswith(".npz"))
-        if not cands:
-            raise FileNotFoundError(
-                f"no .npz checkpoint in {checkpoint_file}")
+                       if f.endswith(".npz") and not _STEP_RE.match(f))
         if len(cands) > 1:
             raise ValueError(
                 f"{checkpoint_file} holds several checkpoints {cands}; "
                 "pass the specific .npz file")
-        checkpoint_file = os.path.join(checkpoint_file, cands[0])
+        if cands:
+            checkpoint_file = os.path.join(checkpoint_file, cands[0])
+        else:
+            # no end-of-training export (the run was preempted before it):
+            # fall back to the newest valid step checkpoint
+            from commefficient_tpu.utils.checkpoint import \
+                find_latest_checkpoint
+            found = find_latest_checkpoint(checkpoint_file)
+            if found is None:
+                raise FileNotFoundError(
+                    f"no .npz checkpoint in {checkpoint_file}")
+            checkpoint_file = found
     import json
 
     from commefficient_tpu.utils.params import flatten_params

@@ -577,10 +577,21 @@ STRAGGLER_ALPHAS = (0.0, 0.3, 0.6)
 STRAGGLER_FAULTS = dict(straggler_frac=0.25, straggler_mult=5.0,
                         dropout_prob=0.10, crash_prob=0.02,
                         base_latency=1.0, latency_sigma=0.25)
+#: the deeper-staleness regime (the ``deep_*`` arms): the M = W / 5x-tail
+#: grid above measured a FLAT alpha sweep — contributions barely age before
+#: they are applied, so the staleness discount has nothing to discount.
+#: Here the apply threshold is raised to M = 2W slots (a contribution waits
+#: across more cohorts before an apply) and the latency tail is heavy
+#: enough (25x stragglers, sigma 0.75) that late arrivals carry REAL
+#: staleness — the configuration where 1/(1+tau)^alpha can actually matter.
+STRAGGLER_DEEP = dict(straggler_frac=0.25, straggler_mult=25.0,
+                      dropout_prob=0.10, crash_prob=0.02,
+                      base_latency=1.0, latency_sigma=0.75)
 STRAGGLER_BUDGET = 600.0   # sim-seconds; ~60 data epochs for buffered
 
 
-def _straggler_run(arm: str, alpha: float, seed: int, quick: bool) -> dict:
+def _straggler_run(arm: str, alpha: float, seed: int, quick: bool,
+                   deep: bool = False) -> dict:
     from commefficient_tpu.data.batching import FedBatcher, val_batches
     from commefficient_tpu.federated.faults import FaultModel
     from commefficient_tpu.training.cv import (build_learner, build_parser,
@@ -588,6 +599,7 @@ def _straggler_run(arm: str, alpha: float, seed: int, quick: bool) -> dict:
 
     argv = task_flags("digits", quick=False) + mode_flags("local_topk",
                                                           "digits")
+    faults = STRAGGLER_DEEP if deep else STRAGGLER_FAULTS
     args = build_parser().parse_args(argv)
     args.lr_scale = 0.05          # the digits/local_topk tuned point
     args.seed = int(seed)
@@ -595,12 +607,17 @@ def _straggler_run(arm: str, alpha: float, seed: int, quick: bool) -> dict:
         args.server_mode = "buffered"
         args.staleness_alpha = float(alpha)
         args.fault_seed = 1000 + int(seed)
-        args.dispatch_interval = STRAGGLER_FAULTS["base_latency"]
+        args.dispatch_interval = faults["base_latency"]
         for k in ("straggler_frac", "straggler_mult", "base_latency",
                   "latency_sigma"):
-            setattr(args, k, STRAGGLER_FAULTS[k])
-        args.fault_dropout_prob = STRAGGLER_FAULTS["dropout_prob"]
-        args.fault_crash_prob = STRAGGLER_FAULTS["crash_prob"]
+            setattr(args, k, faults[k])
+        args.fault_dropout_prob = faults["dropout_prob"]
+        args.fault_crash_prob = faults["crash_prob"]
+        if deep:
+            # M > W: an apply waits for 2 cohorts' worth of arrivals, so
+            # every contribution ages in the buffer instead of being
+            # applied the cohort it lands
+            args.buffer_m = 2 * args.num_workers
 
     train_set = make_dataset(args, train=True)
     val_set = make_dataset(args, train=False)
@@ -625,8 +642,7 @@ def _straggler_run(arm: str, alpha: float, seed: int, quick: bool) -> dict:
         # clients' mask rows zero out (round.py treats an all-zero mask
         # row as a non-participant — no bytes, no contribution) and the
         # barrier bills the straggler tail / timeout to the sim clock
-        fm = FaultModel(1000 + int(seed), args.num_clients,
-                        **STRAGGLER_FAULTS)
+        fm = FaultModel(1000 + int(seed), args.num_clients, **faults)
         for ids, cols, mask in endless_rounds():
             if sim >= T:
                 break
@@ -660,9 +676,13 @@ def _straggler_run(arm: str, alpha: float, seed: int, quick: bool) -> dict:
 
     val = learner.evaluate(val_batches(val_set, args.valid_batch_size))
     label = arm if arm == "sync" else f"buffered_a{alpha:g}"
+    if deep:
+        label = f"deep_{label}"
     row = {
         "arm": label, "alpha": (None if arm == "sync" else float(alpha)),
-        "seed": int(seed), "sim_budget": T,
+        "seed": int(seed), "sim_budget": T, "deep": bool(deep),
+        "buffer_m": (2 * args.num_workers
+                     if deep and arm == "buffered" else None),
         "rounds": int(rounds), "applies": int(applies),
         "sim_time": round(float(sim_final), 1),
         "aborted": bool(np.asarray(learner.state.aborted)),
@@ -694,15 +714,24 @@ def run_straggler(out: str = "RESULTS_straggler",
     done = {(r["arm"], r["seed"]) for r in rows}
     seeds = STRAGGLER_SEEDS[:1] if quick else STRAGGLER_SEEDS
     alphas = STRAGGLER_ALPHAS[1:2] if quick else STRAGGLER_ALPHAS
-    jobs = [("sync", 0.0, s) for s in seeds]
-    jobs += [("buffered", a, s) for a in alphas for s in seeds]
-    for arm, alpha, seed in jobs:
+    jobs = [("sync", 0.0, s, False) for s in seeds]
+    jobs += [("buffered", a, s, False) for a in alphas for s in seeds]
+    if not quick:
+        # the deeper-staleness regime (M = 2W, 25x tail): same resumable
+        # protocol, labels prefixed deep_
+        jobs += [("sync", 0.0, s, True) for s in seeds]
+        jobs += [("buffered", a, s, True)
+                 for a in STRAGGLER_ALPHAS for s in seeds]
+    for arm, alpha, seed, deep in jobs:
         label = arm if arm == "sync" else f"buffered_a{alpha:g}"
+        if deep:
+            label = f"deep_{label}"
         if (label, seed) in done:
             continue
-        rows.append(_straggler_run(arm, alpha, seed, quick))
+        rows.append(_straggler_run(arm, alpha, seed, quick, deep=deep))
         with open(path, "w") as f:
             json.dump({"results": rows, "faults": STRAGGLER_FAULTS,
+                       "deep_faults": STRAGGLER_DEEP,
                        "budget": STRAGGLER_BUDGET if not quick else 40.0,
                        "seeds": list(seeds)}, f, indent=1)
     return rows
@@ -727,6 +756,16 @@ def write_straggler_markdown(rows: list,
         "overlap. Its natural concurrency is ~2x sync's in-flight clients "
         "at these fault rates (see results.py for the accounting).",
         "",
+        "The `deep_*` arms rerun the grid in a deeper-staleness regime: "
+        f"the apply threshold is raised to M = 2W buffer slots (a "
+        f"contribution waits across more cohorts before an apply) and the "
+        f"latency tail is heavier ({STRAGGLER_DEEP['straggler_mult']:g}x "
+        f"stragglers, sigma {STRAGGLER_DEEP['latency_sigma']:g}), so late "
+        "arrivals carry real staleness — the configuration where the "
+        "1/(1+tau)^alpha discount has actual work to do. The shallow grid "
+        "measured a flat alpha sweep; this is the arm that tests whether "
+        "that was a property of the discount or of the regime.",
+        "",
         "| arm | seed | rounds | applies | final val acc | up (MiB) |",
         "|---|---|---|---|---|---|",
     ]
@@ -749,18 +788,49 @@ def write_straggler_markdown(rows: list,
         lines.append(f"| {arm} | {np.mean(accs):.4f} | "
                      f"{min(accs):.4f}..{max(accs):.4f} | "
                      f"{np.mean([r['applies'] for r in sub]):.0f} |")
-    if "sync" in means and len(means) > 1:
-        best_buf = max((a for a in means if a != "sync"),
-                       key=lambda a: means[a])
-        delta = means[best_buf] - means["sync"]
+    for regime, prefix in (("shallow (M = W, 5x tail)", ""),
+                           ("deep (M = 2W, 25x tail)", "deep_")):
+        sync_arm = prefix + "sync"
+        bufs = {a: m for a, m in means.items()
+                if a.startswith(prefix + "buffered")}
+        if not prefix:
+            bufs = {a: m for a, m in bufs.items()
+                    if not a.startswith("deep_")}
+        if sync_arm not in means or not bufs:
+            continue
+        best_buf = max(bufs, key=lambda a: bufs[a])
+        delta = bufs[best_buf] - means[sync_arm]
         verdict = ("confirms" if delta > 0 else "REFUTES")
         lines.append("")
         lines.append(
-            f"At this budget the best buffered arm ({best_buf}) lands "
-            f"{delta:+.4f} accuracy vs sync — this {verdict} the claim "
-            "that buffered aggregation dominates under a straggler/"
-            "dropout regime at fixed wall-clock. The alpha sweep reads "
-            "directly off the summary table above.")
+            f"In the {regime} regime the best buffered arm ({best_buf}) "
+            f"lands {delta:+.4f} accuracy vs {sync_arm} — this {verdict} "
+            "the claim that buffered aggregation dominates under a "
+            "straggler/dropout regime at fixed wall-clock. The alpha "
+            "sweep for this regime reads directly off the summary table "
+            "above.")
+    deep_alpha = {a: m for a, m in means.items()
+                  if a.startswith("deep_buffered")}
+    if len(deep_alpha) > 1:
+        spread = max(deep_alpha.values()) - min(deep_alpha.values())
+        per_seed = [r["final_test_acc"] for r in rows
+                    if r["arm"] in deep_alpha and not r["aborted"]]
+        noise = max(per_seed) - min(per_seed) if per_seed else 0.0
+        sweep = ", ".join(
+            f"alpha={a.split('_a')[-1]}: {deep_alpha[a]:.4f}"
+            for a in sorted(deep_alpha))
+        lines.append("")
+        lines.append(
+            f"Staleness-discount verdict (the honest part): the deep "
+            f"alpha sweep spans {spread:.4f} accuracy ({sweep}) against a "
+            f"{noise:.4f} per-seed spread within the deep buffered arms. "
+            + ("The discount separates from noise in this regime."
+               if spread > noise else
+               "Even with M = 2W forcing every contribution to age and a "
+               "25x tail, the 1/(1+tau)^alpha discount stays within seed "
+               "noise — the flat shallow-regime sweep was a property of "
+               "the discount (uniform cohort staleness under FIFO "
+               "dispatch), not of insufficient staleness depth."))
     lines.append("")
     with open(path, "w") as f:
         f.write("\n".join(lines))

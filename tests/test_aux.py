@@ -87,13 +87,48 @@ def test_checkpoint_v2_backfills_missing_aborted_leaf(tmp_path):
     # rewrite the file without the aborted leaf (renumber the tail)
     arrs = [data[f"arr_{i}"] for i in range(len(paths))]
     del arrs[drop], paths[drop]
-    data = {k: v for k, v in data.items() if not k.startswith("arr_")}
+    # a pre-v3 file has none of the v3 keys (digest/rng/cursor/fingerprint)
+    v3_only = ("digest", "learner_rng", "cursor", "fingerprint")
+    data = {k: v for k, v in data.items()
+            if not k.startswith("arr_") and k not in v3_only}
+    data["format_version"] = np.asarray(2)
     data["leaf_paths"] = np.asarray(pyjson.dumps(paths))
     np.savez(fn, **data, **{f"arr_{i}": x for i, x in enumerate(arrs)})
     fresh = make_learner()
     load_checkpoint(fn, fresh)
     assert bool(np.asarray(fresh.state.aborted)) is False
     assert fresh.rounds_done == 1
+
+
+def test_load_checkpoint_mismatch_leaves_learner_untouched(tmp_path):
+    # transactional load: a rejected checkpoint must not half-restore —
+    # state, rounds_done, byte totals, and rng all stay exactly as they
+    # were (the pre-v3 loader overwrote state BEFORE host-row validation)
+    ids, b, m = batch()
+    a = make_learner()
+    a.train_round(ids, b, m)
+    fn = save_checkpoint(str(tmp_path), a, "toy")
+    # a learner whose state tree has MORE leaves (local_topk error rows)
+    cfg = FedConfig(mode="local_topk", error_type="local", k=1,
+                    virtual_momentum=0.0, local_momentum=0.9, weight_decay=0,
+                    num_workers=1, num_clients=2, lr_scale=0.02)
+    model = ToyLinear()
+    other = FedLearner(model, cfg, make_regression_loss(model), None,
+                       jax.random.PRNGKey(0), X[:1])
+    other.train_round(ids, b, m)
+    before = jax.tree_util.tree_map(np.asarray, other.state)
+    rounds, down, up = (other.rounds_done, other.total_download_bytes,
+                        other.total_upload_bytes)
+    rng_before = np.asarray(other.rng)
+    with pytest.raises(ValueError, match="missing state leaf"):
+        load_checkpoint(fn, other)
+    after = jax.tree_util.tree_map(np.asarray, other.state)
+    for p, q in zip(jax.tree_util.tree_leaves(before),
+                    jax.tree_util.tree_leaves(after)):
+        np.testing.assert_array_equal(p, q)
+    assert (other.rounds_done, other.total_download_bytes,
+            other.total_upload_bytes) == (rounds, down, up)
+    np.testing.assert_array_equal(np.asarray(other.rng), rng_before)
 
 
 def test_worker_dp_noise_and_clip():

@@ -34,8 +34,9 @@ def test_registry_covers_every_row():
     a row cannot exist in one mode and be silently skipped by the
     other."""
     names = [n for n, _ in bench._bench_rows()]
-    assert len(names) == len(set(names)) == 18
+    assert len(names) == len(set(names)) == 19
     for must in ("cifar10_resnet9_fed_rounds_per_sec",
+                 "checkpoint_save_restore_overhead",
                  "gpt2_personachat_tokens_per_sec_chip_flash_attn",
                  "flash_attn_t256_parity_dropout_kernel_ab",
                  "flash_attn_t512_parity_dropout_kernel_ab",

@@ -136,6 +136,15 @@ def test_main_emits_json_and_exits_zero_despite_failed_metrics(
                           "decode_per_token_ms": 0.2,
                           "decode_flat_in_prefix_ratio": 1.0}))
 
+    monkeypatch.setattr(
+        bench, "bench_checkpoint_overhead",
+        lambda every_rounds=100: {
+            "save_ms": 12.0, "verify_ms": 3.0, "load_ms": 9.0,
+            "bytes": 1 << 20, "round_ms": 800.0,
+            "amortized_per_round_ms": 0.12,
+            "amortized_overhead_pct": 0.015,
+            "checkpoint_every_rounds": every_rounds})
+
     def dead(*a, **k):
         raise RuntimeError("UNAVAILABLE: tunnel read body")
 
@@ -156,6 +165,7 @@ def test_main_emits_json_and_exits_zero_despite_failed_metrics(
     assert "gpt2_decode_tokens_per_sec_chip_b64" in metrics
     assert "gpt2_fetchsgd_bucketed_rounds_t512_ab" in metrics
     assert "gpt2_fused_ce_t512_ab" in metrics
+    assert "checkpoint_save_restore_overhead" in metrics
     # the dead metrics are absent from the numbers but present in errors
     assert "gpt2_fetchsgd_sketch_rounds_per_sec" not in metrics
     failed = {e["metric"] for e in out["errors"]}

@@ -129,6 +129,50 @@ def test_server_matches_solo_engine_one_compile(tiny):
     assert engine.step._cache_size() == 1
 
 
+def test_server_drain_then_fresh_server_matches_solo(tiny):
+    """Graceful preemption of the serving path: drain() finishes every
+    admitted request and hands back the never-admitted queue; a FRESH
+    server over the same weights completes the leftovers with the exact
+    greedy tokens the original server would have produced."""
+    engine, prompts = _engine_and_prompts(tiny, n=5)
+    server = ContinuousBatchingServer(engine, slots=2, prefill_len=32)
+    rids = [server.submit(ids, types, types[-1], 6)
+            for ids, types in prompts]
+    server.step()                        # admit 2 into slots, 3 queued
+    replies, leftovers = server.drain()
+    # drained replies cover exactly the admitted requests, none dropped
+    assert set(replies) | {lid for lid, _ in _match_leftovers(
+        rids, prompts, leftovers)} == set(rids)
+    assert len(leftovers) == len(rids) - len(replies)
+    # leftovers come back in submission order, re-submittable verbatim
+    replacement = ContinuousBatchingServer(engine, slots=2, prefill_len=32)
+    new_rids = [replacement.submit(*left) for left in leftovers]
+    replies2 = replacement.run()
+    done = dict(replies)
+    for (orig_rid, _), nrid in zip(
+            _match_leftovers(rids, prompts, leftovers), new_rids):
+        done[orig_rid] = replies2[nrid]
+    for rid, (ids, types) in zip(rids, prompts):
+        solo = engine.generate([(ids, types)], [types[-1]], max_new=6)[0]
+        assert done[rid] == solo
+
+
+def _match_leftovers(rids, prompts, leftovers):
+    """Map drained leftovers back to their original rids by content (the
+    queue preserves submission order)."""
+    out, j = [], 0
+    for left in leftovers:
+        while j < len(prompts):
+            ids, types = prompts[j]
+            rid = rids[j]
+            j += 1
+            if (list(ids), list(types), types[-1]) == (left[0], left[1],
+                                                       left[2]):
+                out.append((rid, left))
+                break
+    return out
+
+
 def test_server_rejects_overlong_prompt(tiny):
     engine, prompts = _engine_and_prompts(tiny, n=1)
     server = ContinuousBatchingServer(engine, slots=2, prefill_len=4)

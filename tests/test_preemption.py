@@ -1,0 +1,375 @@
+"""Preemption tolerance: the deterministic kill-and-restart harness
+(docs/ROBUSTNESS.md "Preemption").
+
+The subprocess tests run the real CLI (`training.cv.main`) in a child
+process, SIGKILL it at an arbitrary mid-training point (and separately
+*mid-`save_checkpoint`*, between the temp-file fsync and the atomic
+rename, via the COMMEFF_CRASH_POINT hook), restart with ``--resume
+auto``, and assert the final exported state is **bitwise identical**
+(`assert_array_equal`) to a never-killed run — for the sync server (with
+and without ``--client_state_offload``) and the buffered server.
+(buffered + offload is rejected at config level: contribution slots
+already buffer the sampled rows.)
+
+The in-process tests cover the checkpoint-format pieces in isolation:
+corrupt-file fallback, digest rejection, retention, fingerprint
+mismatch, and the sampler/batcher skip-replay equivalence the bitwise
+contract stands on.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHILD = textwrap.dedent("""
+    import sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from commefficient_tpu.training.cv import main
+    sys.exit(main(sys.argv[1:]))
+""")
+
+#: digits/TinyMLP at ~132 rounds over 1.4 epochs: long enough that the
+#: poll-then-SIGKILL always lands mid-training, small enough for tier-1
+_BASE = ["--model", "TinyMLP", "--dataset_name", "Digits",
+         "--num_workers", "2", "--local_batch_size", "8",
+         "--valid_batch_size", "128", "--lr_scale", "0.01",
+         "--num_epochs", "1.4", "--seed", "3"]
+
+_CONFIGS = {
+    "sync": ["--mode", "local_topk", "--error_type", "local", "--k", "5"],
+    "sync_offload": ["--mode", "local_topk", "--error_type", "local",
+                     "--k", "5", "--client_state_offload"],
+    "buffered": ["--mode", "local_topk", "--error_type", "local",
+                 "--k", "5", "--server_mode", "buffered"],
+}
+
+
+def _launch(workdir, argv, env_extra=None):
+    script = os.path.join(str(workdir), "child.py")
+    if not os.path.exists(script):
+        with open(script, "w") as f:
+            f.write(CHILD)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    # the parent's 8-virtual-device flag (conftest) is for mesh tests;
+    # children run single-device like the real single-chip CLI
+    env.pop("XLA_FLAGS", None)
+    env.pop("COMMEFF_CRASH_POINT", None)
+    env.pop("COMMEFF_CRASH_AT_SAVE", None)
+    if env_extra:
+        env.update(env_extra)
+    return subprocess.Popen([sys.executable, script] + argv, env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+
+
+def _run(workdir, argv, env_extra=None, timeout=240):
+    p = _launch(workdir, argv, env_extra)
+    out, _ = p.communicate(timeout=timeout)
+    return p.returncode, out
+
+
+def _kill_when_step_file(workdir, argv, ckpt_dir, sig=signal.SIGKILL,
+                        timeout=240):
+    """Start the CLI, wait for the first periodic step checkpoint to
+    appear, then deliver ``sig`` — the arbitrary-point preemption."""
+    p = _launch(workdir, argv)
+    deadline = time.time() + timeout
+    try:
+        while time.time() < deadline:
+            if p.poll() is not None:
+                out, _ = p.communicate()
+                raise AssertionError(
+                    f"child exited (rc={p.returncode}) before it could be "
+                    f"killed mid-training:\n{out}")
+            saved = (os.path.isdir(ckpt_dir)
+                     and any("_r" in f and f.endswith(".npz")
+                             for f in os.listdir(ckpt_dir)))
+            if saved:
+                p.send_signal(sig)
+                break
+            time.sleep(0.02)
+        out, _ = p.communicate(timeout=timeout)
+    finally:
+        if p.poll() is None:
+            p.kill()
+    return p.returncode, out
+
+
+def _assert_final_bitwise(dir_a, dir_b, name="TinyMLP"):
+    with np.load(os.path.join(str(dir_a), f"{name}.npz")) as a, \
+            np.load(os.path.join(str(dir_b), f"{name}.npz")) as b:
+        keys = [k for k in a.files
+                if k.startswith("arr_") or k.startswith("host_")]
+        keys += ["rounds_done", "total_download_bytes",
+                 "total_upload_bytes", "learner_rng"]
+        for k in keys:
+            np.testing.assert_array_equal(
+                a[k], b[k], err_msg=f"final checkpoint key {k!r} differs "
+                f"between uninterrupted and killed+resumed run")
+
+
+def _baseline(tmp_path_factory, cfg_key):
+    """Uninterrupted run of one config; its final export is the bitwise
+    reference every interrupted variant is compared against."""
+    d = tmp_path_factory.mktemp(f"base_{cfg_key}")
+    ckpt = os.path.join(str(d), "ckpt")
+    rc, out = _run(d, _BASE + _CONFIGS[cfg_key]
+                   + ["--dataset_dir", str(d / "ds"),
+                      "--checkpoint", "--checkpoint_path", ckpt])
+    assert rc == 0, out
+    return ckpt
+
+
+@pytest.fixture(scope="module")
+def sync_baseline(tmp_path_factory):
+    return _baseline(tmp_path_factory, "sync")
+
+
+def _kill_resume_roundtrip(tmp_path, cfg_key, baseline_ckpt):
+    ckpt = os.path.join(str(tmp_path), "ckpt")
+    argv = _BASE + _CONFIGS[cfg_key] + [
+        "--dataset_dir", str(tmp_path / "ds"), "--checkpoint",
+        "--checkpoint_path", ckpt, "--checkpoint_every_rounds", "10"]
+    rc, out = _kill_when_step_file(tmp_path, argv, ckpt)
+    assert rc == -signal.SIGKILL, out
+    # the kill interrupted the run: no final export yet
+    assert not os.path.exists(os.path.join(ckpt, "TinyMLP.npz"))
+    rc, out = _run(tmp_path, argv + ["--resume", "auto"])
+    assert rc == 0, out
+    assert "resumed from" in out, out
+    _assert_final_bitwise(baseline_ckpt, ckpt)
+
+
+def test_crash_resume_smoke(tmp_path, sync_baseline):
+    """SIGKILL at an arbitrary round, --resume auto, bitwise final state.
+    This is the CI smoke target (tier1.yml crash-resume job)."""
+    _kill_resume_roundtrip(tmp_path, "sync", sync_baseline)
+
+
+@pytest.mark.parametrize("cfg_key", ["sync_offload", "buffered"])
+def test_kill_resume_bitwise(tmp_path, tmp_path_factory, cfg_key):
+    _kill_resume_roundtrip(tmp_path, cfg_key,
+                           _baseline(tmp_path_factory, cfg_key))
+
+
+def test_sigkill_mid_save_keeps_previous_checkpoint(tmp_path,
+                                                    sync_baseline):
+    """The torn-write case: SIGKILL lands INSIDE save_checkpoint, after
+    the temp file is fsynced but before the atomic rename. The previous
+    checkpoint must stay loadable and the resume still bitwise."""
+    ckpt = os.path.join(str(tmp_path), "ckpt")
+    argv = _BASE + _CONFIGS["sync"] + [
+        "--dataset_dir", str(tmp_path / "ds"), "--checkpoint",
+        "--checkpoint_path", ckpt, "--checkpoint_every_rounds", "10"]
+    rc, out = _run(tmp_path, argv,
+                   env_extra={"COMMEFF_CRASH_POINT": "ckpt_before_replace",
+                              "COMMEFF_CRASH_AT_SAVE": "2"})
+    assert rc == -signal.SIGKILL, out
+    files = os.listdir(ckpt)
+    # the second save died pre-rename: its temp file is the only trace
+    assert any(f.endswith(".tmp") for f in files), files
+    assert "TinyMLP_r00000010.npz" in files, files
+    rc, out = _run(tmp_path, argv + ["--resume", "auto"])
+    assert rc == 0, out
+    assert "TinyMLP_r00000010.npz" in out  # fell back to the good save
+    _assert_final_bitwise(sync_baseline, ckpt)
+
+
+def test_sigterm_finishes_round_saves_and_exits(tmp_path, sync_baseline):
+    """The preemption-notice path: SIGTERM -> finish the in-flight round,
+    write a checkpoint, exit 0 — then a restart is bitwise too."""
+    ckpt = os.path.join(str(tmp_path), "ckpt")
+    argv = _BASE + _CONFIGS["sync"] + [
+        "--dataset_dir", str(tmp_path / "ds"), "--checkpoint",
+        "--checkpoint_path", ckpt, "--checkpoint_every_rounds", "10"]
+    rc, out = _kill_when_step_file(tmp_path, argv, ckpt,
+                                   sig=signal.SIGTERM)
+    assert rc == 0, out
+    assert "signal 15" in out, out
+    assert "preempted" in out, out
+    rc, out = _run(tmp_path, argv + ["--resume", "auto"])
+    assert rc == 0, out
+    _assert_final_bitwise(sync_baseline, ckpt)
+
+
+# ---------------------------------------------------------------------------
+# in-process: checkpoint format pieces in isolation
+# ---------------------------------------------------------------------------
+
+def _toy_learner():
+    import jax
+
+    from commefficient_tpu.config import FedConfig
+    from commefficient_tpu.federated.api import FedLearner
+    from commefficient_tpu.federated.losses import make_regression_loss
+    from commefficient_tpu.models import ToyLinear
+    X = np.asarray([[0.0], [1.0], [2.0], [3.0]], np.float32)
+    cfg = FedConfig(mode="uncompressed", virtual_momentum=0.9,
+                    local_momentum=0, error_type="none", weight_decay=0,
+                    num_workers=1, num_clients=2, lr_scale=0.02)
+    model = ToyLinear()
+    ln = FedLearner(model, cfg, make_regression_loss(model), None,
+                    jax.random.PRNGKey(0), X[:1])
+    batch = (np.array([0]), (X[None], X[None]), np.ones((1, 4), np.float32))
+    return ln, batch
+
+
+def test_find_latest_falls_back_past_corrupt(tmp_path):
+    from commefficient_tpu.utils.checkpoint import (CheckpointError,
+                                                    find_latest_checkpoint,
+                                                    load_checkpoint,
+                                                    save_checkpoint,
+                                                    verify_checkpoint)
+    ln, (ids, b, m) = _toy_learner()
+    ln.train_round(ids, b, m)
+    save_checkpoint(str(tmp_path), ln, "toy", step=10)
+    ln.train_round(ids, b, m)
+    newest = save_checkpoint(str(tmp_path), ln, "toy", step=20)
+    assert find_latest_checkpoint(str(tmp_path), "toy") == newest
+    # truncate the newest file (a crash mid-rename cannot produce this —
+    # that's what the atomic replace prevents — but disk corruption can)
+    raw = open(newest, "rb").read()
+    with open(newest, "wb") as f:
+        f.write(raw[:len(raw) // 2])
+    with pytest.raises(CheckpointError):
+        verify_checkpoint(newest)
+    fallback = find_latest_checkpoint(str(tmp_path), "toy")
+    assert fallback.endswith("toy_r00000010.npz")
+    fresh, _ = _toy_learner()
+    info = load_checkpoint(fallback, fresh)
+    assert info["rounds_done"] == fresh.rounds_done == 1
+
+
+def test_digest_rejects_bit_flip(tmp_path):
+    from commefficient_tpu.utils.checkpoint import (CheckpointError,
+                                                    save_checkpoint,
+                                                    verify_checkpoint)
+    ln, (ids, b, m) = _toy_learner()
+    ln.train_round(ids, b, m)
+    fn = save_checkpoint(str(tmp_path), ln, "toy", step=5)
+    # a valid zip whose payload silently changed: only the digest catches it
+    with np.load(fn) as z:
+        data = {k: z[k] for k in z.files}
+    w = data["arr_0"].copy()
+    w.flat[0] += 1.0
+    data["arr_0"] = w
+    np.savez(fn, **data)
+    with pytest.raises(CheckpointError, match="digest"):
+        verify_checkpoint(fn)
+
+
+def test_step_retention_keeps_newest_and_plain_export(tmp_path):
+    from commefficient_tpu.utils.checkpoint import save_checkpoint
+    ln, (ids, b, m) = _toy_learner()
+    ln.train_round(ids, b, m)
+    save_checkpoint(str(tmp_path), ln, "toy")  # end-of-training export
+    for step in (10, 20, 30, 40):
+        save_checkpoint(str(tmp_path), ln, "toy", step=step, keep=3)
+    files = sorted(os.listdir(str(tmp_path)))
+    assert "toy.npz" in files  # plain export never pruned
+    steps = [f for f in files if "_r" in f and f.endswith(".npz")]
+    assert steps == ["toy_r00000020.npz", "toy_r00000030.npz",
+                     "toy_r00000040.npz"]
+    with open(os.path.join(str(tmp_path), "toy.latest")) as f:
+        assert f.read().strip() == "toy_r00000040.npz"
+
+
+def test_fingerprint_mismatch_fails_loudly_and_untouched(tmp_path):
+    from commefficient_tpu.utils.checkpoint import (load_checkpoint,
+                                                    save_checkpoint)
+    ln, (ids, b, m) = _toy_learner()
+    ln.train_round(ids, b, m)
+    fn = save_checkpoint(str(tmp_path), ln, "toy", step=1,
+                         fingerprint={"lr_scale": 0.02, "seed": 3})
+    fresh, _ = _toy_learner()
+    w0 = np.asarray(fresh.state.weights).copy()
+    with pytest.raises(ValueError, match="different config"):
+        load_checkpoint(fn, fresh,
+                        expect_fingerprint={"lr_scale": 0.4, "seed": 3})
+    # transactional: the rejected load didn't half-restore
+    np.testing.assert_array_equal(np.asarray(fresh.state.weights), w0)
+    assert fresh.rounds_done == 0
+    # matching fingerprint loads fine
+    info = load_checkpoint(fn, fresh,
+                           expect_fingerprint={"lr_scale": 0.02, "seed": 3})
+    assert info["fingerprint"]["seed"] == 3
+
+
+def test_batcher_skip_replays_identical_rounds(tmp_path):
+    """epoch(skip=k) must reproduce rounds k.. of the uninterrupted epoch
+    AND leave the RNGs where a fully-consumed epoch would — the property
+    the bitwise-resume contract reduces to at the data layer."""
+    from commefficient_tpu.data import FedBatcher
+    from commefficient_tpu.training.args import build_parser
+    from commefficient_tpu.training.cv import make_dataset
+    argv = ["--dataset_name", "Digits", "--dataset_dir", str(tmp_path),
+            "--num_workers", "2", "--local_batch_size", "16",
+            "--seed", "7"]
+    args = build_parser(default_lr=0.1).parse_args(argv)
+    ds = make_dataset(args, train=True)
+    k = 5
+
+    def rounds_of(batcher, skip=0):
+        return [(ids.copy(), tuple(np.asarray(c).copy() for c in cols),
+                 mask.copy())
+                for ids, cols, mask in batcher.epoch(skip=skip)]
+
+    a = FedBatcher(ds, 2, 16, seed=7)
+    full_e0 = rounds_of(a)
+    full_e1 = rounds_of(a)
+
+    b = FedBatcher(ds, 2, 16, seed=7)
+    tail_e0 = rounds_of(b, skip=k)
+    next_e1 = rounds_of(b)
+
+    assert len(tail_e0) == len(full_e0) - k
+    for (ia, ca, ma), (ib, cb, mb) in zip(full_e0[k:], tail_e0):
+        np.testing.assert_array_equal(ia, ib)
+        np.testing.assert_array_equal(ma, mb)
+        for x, y in zip(ca, cb):
+            np.testing.assert_array_equal(x, y)
+    # the skipped epoch consumed the SAME rng draws: epoch 1 is bitwise
+    for (ia, ca, ma), (ib, cb, mb) in zip(full_e1, next_e1):
+        np.testing.assert_array_equal(ia, ib)
+        for x, y in zip(ca, cb):
+            np.testing.assert_array_equal(x, y)
+
+
+def test_batcher_cursor_roundtrip(tmp_path):
+    """cursor()/restore_cursor() restore mid-epoch: a fresh batcher with
+    the restored cursor replays the epoch bitwise from round k."""
+    from commefficient_tpu.data import FedBatcher
+    from commefficient_tpu.training.args import build_parser
+    from commefficient_tpu.training.cv import make_dataset
+    argv = ["--dataset_name", "Digits", "--dataset_dir", str(tmp_path),
+            "--num_workers", "2", "--local_batch_size", "16",
+            "--seed", "11"]
+    args = build_parser(default_lr=0.1).parse_args(argv)
+    ds = make_dataset(args, train=True)
+
+    a = FedBatcher(ds, 2, 16, seed=11)
+    it = a.epoch()
+    seen = [next(it) for _ in range(4)]  # 4 rounds trained, then "killed"
+    cur = a.cursor(in_epoch=True)
+    expect = next(it)  # round 5 of the uninterrupted run
+
+    ds2 = make_dataset(args, train=True)
+    b = FedBatcher(ds2, 2, 16, seed=999)  # wrong seed: cursor must win
+    b.restore_cursor(cur, in_epoch=True)
+    got = next(iter(b.epoch(skip=4)))
+    np.testing.assert_array_equal(expect[0], got[0])
+    np.testing.assert_array_equal(expect[2], got[2])
+    for x, y in zip(expect[1], got[1]):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    del seen
