@@ -715,6 +715,86 @@ def bench_offload_overlap(n_rounds=8):
     }
 
 
+def bench_client_store_gather_scatter(scales=(10_000, 1_000_000),
+                                      n_rounds=8):
+    """Million-client host arenas (federated/client_store.HostArenaStore):
+    per-client state lives host-side as O(k) sparse rows, so the arena is
+    num_clients * k floats/ints — not num_clients * d — and the device
+    only ever sees the W sampled rows' dense decodes per round. This row
+    runs the same TinyMLP local_topk round at num_clients = 1e4 and 1e6
+    and reports per-round gather/scatter host time plus the arena's
+    actual bytes at each scale: gather/scatter cost must track the cohort
+    width W (flat across scales), while arena bytes track n * k — the
+    docs/SCALING.md memory model, O(num_clients*k + W*d)."""
+    import jax
+    import jax.numpy as jnp
+
+    from commefficient_tpu.config import FedConfig
+    from commefficient_tpu.federated.api import FedLearner
+    from commefficient_tpu.federated.losses import make_cv_loss
+    from commefficient_tpu.models import TinyMLP
+
+    W, B, F = 8, 16, 8
+    model = TinyMLP(num_classes=10, hidden=32)  # d = 618
+    rng = np.random.RandomState(0)
+    feats = jnp.asarray(rng.randn(W, B, F).astype(np.float32))
+    targets = jnp.asarray(rng.randint(0, 10, (W, B)).astype(np.int32))
+    mask = jax.device_put(jnp.ones((W, B), jnp.float32))
+    batch = (jax.device_put(feats), jax.device_put(targets))
+
+    def make_learner(n):
+        cfg = FedConfig(mode="local_topk", k=32, error_type="local",
+                        local_momentum=0.9, virtual_momentum=0,
+                        num_workers=W, num_clients=n, lr_scale=0.1,
+                        client_state="sparse", client_state_offload=True)
+        return FedLearner(model, cfg, make_cv_loss(model), None,
+                          jax.random.PRNGKey(0), np.asarray(feats[0][:1]))
+
+    def make_ids_fn(n):
+        # scattered ids (not a contiguous window) so the gather walks the
+        # arena the way production sampling does
+        def ids_fn(r):
+            return np.random.RandomState(r).choice(n, size=W,
+                                                   replace=False)
+        return ids_fn
+
+    def tag(n):
+        return f"{n // 1_000_000}m" if n >= 1_000_000 else f"{n // 1000}k"
+
+    if DRY_RUN:
+        # both scales must build + trace: the 1M arena is host numpy and
+        # the traced round's row input stays (W, d) regardless of n
+        status = None
+        for n in scales:
+            ln = make_learner(n)
+            status = _dry_trace_round(ln, make_ids_fn(n), batch, mask)
+            arena = ln.host_store.nbytes()
+            # 8 bytes per (idx, val) entry per field; 3 fields is the
+            # ceiling — anything near n*d*4 means a dense arena snuck in
+            assert arena <= 24 * n * ln.cfg.k, \
+                f"arena not O(n*k): {arena} bytes at n={n}"
+        return status
+
+    out = {}
+    for n in scales:
+        ln = make_learner(n)
+        ids_fn = make_ids_fn(n)
+        ln.train_round(ids_fn(0), batch, mask)  # compile
+        ln.train_round(ids_fn(1), batch, mask)  # warm
+        stats = ln._offload_pipe.stats
+        stats["gather_s"] = stats["scatter_s"] = 0.0
+        t0 = time.perf_counter()
+        for r in range(n_rounds):
+            ln.train_round(ids_fn(2 + r), batch, mask)
+        t = tag(n)
+        out[f"round_ms_{t}"] = round(
+            (time.perf_counter() - t0) / n_rounds * 1e3, 2)
+        out[f"gather_ms_{t}"] = round(stats["gather_s"] / n_rounds * 1e3, 2)
+        out[f"scatter_ms_{t}"] = round(stats["scatter_s"] / n_rounds * 1e3, 2)
+        out[f"arena_mb_{t}"] = round(ln.host_store.nbytes() / 2**20, 1)
+    return out
+
+
 def bench_buffered_rounds(n_rounds=8):
     """Buffered async server (federated/buffer.py) vs the sync round at
     the same config — ResNet9 local_topk, the offload row's scale.
@@ -1126,6 +1206,8 @@ def _bench_rows():
          lambda: bench_longcontext_tokens()),
         ("offload_gather_scatter_overlap",
          lambda: bench_offload_overlap()),
+        ("client_store_gather_scatter_1m",
+         lambda: bench_client_store_gather_scatter()),
         ("buffered_fedbuff_round_overhead",
          lambda: bench_buffered_rounds()),
         ("checkpoint_save_restore_overhead",
@@ -1310,6 +1392,15 @@ def main():
         "rounds/sec", {"topk_approx_recall": 0.0})
     add("gpt2_longcontext_4k_blockwise_tokens_per_sec_chip",
         round(longctx, 1) if longctx is not None else None, "tokens/sec")
+    cstore = res["client_store_gather_scatter_1m"]
+    add("client_store_gather_scatter_1m",
+        cstore.get("gather_ms_1m") if cstore is not None else None, "ms",
+        dict(cstore, **{
+            "note": "per-round host gather time at num_clients=1e6 with "
+                    "sparse O(k) host arenas (client_store.py); "
+                    "gather/scatter cost tracks cohort width W, arena "
+                    "bytes track n*k — full breakdown at both 1e4 and "
+                    "1e6 in config"}) if cstore is not None else None)
     ckpt = res["checkpoint_save_restore_overhead"]
     add("checkpoint_save_restore_overhead",
         ckpt["save_ms"] if ckpt is not None else None, "ms",

@@ -24,8 +24,8 @@ def main(argv=None) -> int:
                     "retrace / dtype / prng)")
     parser.add_argument("--target", default="all",
                         choices=["round", "round_bucketed", "buffered",
-                                 "gpt2", "attention", "sketch", "decode",
-                                 "all"])
+                                 "client_store", "gpt2", "attention",
+                                 "sketch", "decode", "all"])
     parser.add_argument("--no-retrace", action="store_true",
                         help="skip the (compile-heavy) retrace guards")
     parser.add_argument("--prng-lint", action="store_true",

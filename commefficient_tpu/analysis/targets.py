@@ -278,6 +278,78 @@ def buffered_target() -> AuditTarget:
 
 
 # --------------------------------------------------------------------------
+# client state store (placement x representation)
+# --------------------------------------------------------------------------
+
+def client_store_target(mutate: bool = False) -> AuditTarget:
+    """The million-client round: host-arena placement + sparse O(k) rows
+    (federated/client_store.py). The audited program is the OFFLOAD round
+    — client rows live in per-shard host arenas, the jit receives only
+    the W sampled rows — so a ``(num_clients, d)`` aval anywhere in the
+    jaxpr is a dense device arena leaking back in. The rule is STRICT:
+    unlike ``round/local_topk``'s footprint ban, no scatter-writeback
+    allowlist applies, because the offload program has no legitimate
+    n-leading eqn at all.
+
+    ``mutate=True`` builds the same config with device-resident dense
+    state — the program a dense-arena reintroduction would produce — and
+    the audit must FAIL on it (tests/test_client_store.py pins this),
+    which is what makes a PASS on the real program meaningful.
+    """
+    w, n_clients = 3, 9
+    # k=24 >= d/2=23: the local_topk residual has nnz <= d - k <= k, so
+    # the sparse codec is exact (the bitwise dense<->sparse contract)
+    cfg_kw = dict(mode="local_topk", error_type="local",
+                  local_momentum=0.9, k=24, client_state="sparse",
+                  client_state_offload=True)
+    if mutate:
+        cfg_kw.update(client_state="dense", client_state_offload=False)
+    ln = _make_learner(num_workers=w, num_clients=n_clients, **cfg_kw)
+    d = int(ln.state.last_changed.shape[0])
+    batch, mask = _round_batch(w)
+    ids = jnp.arange(w, dtype=jnp.int32)
+
+    if mutate:
+        def trace():
+            return jax.make_jaxpr(ln._round.raw)(
+                ln.state, ids, batch, mask, jnp.float32(0.05),
+                jax.random.PRNGKey(0))
+    else:
+        rows = ln._offload_pipe.gather(np.arange(w))
+
+        def trace():
+            return jax.make_jaxpr(ln._round.raw)(
+                ln.state, rows, ids, batch, mask, jnp.float32(0.05),
+                jax.random.PRNGKey(0))
+
+    def retrace():
+        rng = np.random.RandomState(3)
+
+        def drive(i):
+            ids_i = rng.choice(n_clients, w, replace=False)
+            b, m = _round_batch(w, rng)
+            ln.train_round_async(ids_i, b, m)
+
+        return check_retrace(ln._round, None, repeats=3, warmup=1,
+                             drive=drive)
+
+    strict = ShapePattern(("num_clients", "d"),
+                          label="dense client arena",
+                          allow_primitives=frozenset())
+    return AuditTarget(
+        name="client_store/offload-sparse" + ("(mutated)" if mutate else ""),
+        description="offload round with sparse O(k) client rows; strict "
+                    "no-(num_clients, d) ban"
+                    + (" [device-dense mutation — must fail]"
+                       if mutate else ""),
+        trace=trace,
+        dims={"num_clients": n_clients, "d": d},
+        rules=(FootprintRule((strict,) + DEFAULT_PATTERNS[1:]),
+               TransferRule()),
+        retrace=retrace)
+
+
+# --------------------------------------------------------------------------
 # GPT2 train step (remat=True)
 # --------------------------------------------------------------------------
 
@@ -560,10 +632,13 @@ def build_targets(name: str) -> list:
                 round_bucketed_target("sketch")]
     if name == "decode":
         return [decode_target("step"), decode_target("generate")]
+    if name == "client_store":
+        return [client_store_target()]
     if name == "all":
         return (build_targets("round") + build_targets("round_bucketed")
-                + build_targets("buffered") + build_targets("gpt2")
-                + build_targets("attention") + build_targets("sketch")
-                + build_targets("decode"))
+                + build_targets("buffered") + build_targets("client_store")
+                + build_targets("gpt2") + build_targets("attention")
+                + build_targets("sketch") + build_targets("decode"))
     raise ValueError(f"unknown audit target {name!r} (round|round_bucketed|"
-                     f"buffered|gpt2|attention|sketch|decode|all)")
+                     f"buffered|client_store|gpt2|attention|sketch|decode|"
+                     f"all)")

@@ -17,6 +17,12 @@ MODES = ("sketch", "true_topk", "local_topk", "fedavg", "uncompressed")
 ERROR_TYPES = ("none", "local", "virtual")
 DP_MODES = ("worker", "server")
 SERVER_MODES = ("sync", "buffered")
+#: Per-client state REPRESENTATIONS (federated/client_store.py). 'dense'
+#: stores (d,) rows verbatim; 'sparse' stores local_topk residual rows as
+#: (k,) index/value pairs (exact whenever a row has <= k nonzeros, largest-
+#: magnitude truncation beyond); 'sketched' stores a per-client (r, c)
+#: CountSketch of the error row (bounded-divergence heavy-hitter memory).
+CLIENT_STATE_REPS = ("dense", "sparse", "sketched")
 
 
 @dataclass(frozen=True)
@@ -86,12 +92,25 @@ class FedConfig:
     num_clients: int = 10
     num_workers: int = 1  # clients sampled per round
     # Host-offloaded client state: per-client velocity/error/weight rows
-    # live in TPU-host pinned memory (num_clients x d bounded by host RAM,
-    # not HBM — the reference's shm design, fed_aggregator.py:116-129, done
+    # live in host-side arenas (num_clients x row bounded by host RAM, not
+    # HBM — the reference's shm design, fed_aggregator.py:116-129, done
     # TPU-natively); only the <=num_workers sampled rows move to device per
-    # round. Trajectory-identical to device-resident state
-    # (tests/test_offload.py); incompatible with --mesh and --scan_rounds.
+    # round. On a mesh the arena row space is sharded across the 'clients'
+    # axis — each host owns its row shard and the offload pipeline routes
+    # sampled ids to their owning shard (federated/client_store.py).
+    # Trajectory-identical to device-resident state (tests/test_offload.py);
+    # incompatible with --scan_rounds (rows are host-gathered per round).
     client_state_offload: bool = False
+    # Per-client state REPRESENTATION (CLIENT_STATE_REPS above;
+    # federated/client_store.py). 'sparse'/'sketched' bound per-client
+    # state at O(k) / O(r*c) per row instead of O(d) — the axis that takes
+    # stateful modes from ~50 clients to millions (docs/SCALING.md).
+    # Composes with client_state_offload (placement x representation).
+    client_state: str = "dense"
+    # CountSketch dims for client_state='sketched' (per-client (r, c)
+    # error table; ops/countsketch.py 'global' scheme).
+    client_sketch_rows: int = 3
+    client_sketch_cols: int = 128
     # Offload pipeline depth (api.HostOffloadPipeline): how many rounds of
     # output rows may sit in the lazy-writeback queue while their (W, d)
     # device buffers stay alive. 2 = double buffering (gather round t+1 /
@@ -182,6 +201,45 @@ class FedConfig:
         if self.offload_pipeline_depth < 1:
             raise ValueError("offload_pipeline_depth must be >= 1, got "
                              f"{self.offload_pipeline_depth}")
+        # representation allowlist (MODES-style): each compressed
+        # representation is only defined for modes whose rows it can
+        # actually carry (federated/client_store.py)
+        if self.client_state not in CLIENT_STATE_REPS:
+            raise ValueError(f"client_state must be one of "
+                             f"{CLIENT_STATE_REPS}, got {self.client_state!r}")
+        if self.client_state == "sparse":
+            if self.mode != "local_topk":
+                raise ValueError(
+                    "client_state='sparse' stores local_topk residual rows "
+                    "as (k,) index/value pairs; mode "
+                    f"{self.mode!r} keeps no k-sparse client rows")
+            if self.do_topk_down:
+                raise ValueError(
+                    "client_state='sparse' cannot represent topk_down "
+                    "stale-weight rows (dense by construction); drop "
+                    "--topk_down or use client_state='dense'")
+        if self.client_state == "sketched":
+            if self.error_type != "local":
+                raise ValueError(
+                    "client_state='sketched' sketches per-client error "
+                    f"rows; error_type {self.error_type!r} keeps no "
+                    "per-client error state")
+            if self.local_momentum > 0 and self.mode != "sketch":
+                raise ValueError(
+                    "client_state='sketched' cannot carry local momentum "
+                    "rows (momentum factor masking needs the exact "
+                    "support); set local_momentum 0 or use "
+                    "client_state='dense'")
+            if self.do_topk_down:
+                raise ValueError(
+                    "client_state='sketched' cannot represent topk_down "
+                    "stale-weight rows; drop --topk_down or use "
+                    "client_state='dense'")
+            if self.client_sketch_rows < 1 or self.client_sketch_cols < 1:
+                raise ValueError(
+                    "client_state='sketched' needs client_sketch_rows >= 1 "
+                    "and client_sketch_cols >= 1, got "
+                    f"({self.client_sketch_rows}, {self.client_sketch_cols})")
         if self.grad_buckets < 1:
             raise ValueError("grad_buckets must be >= 1, got "
                              f"{self.grad_buckets}")

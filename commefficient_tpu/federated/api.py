@@ -26,6 +26,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from commefficient_tpu.config import FedConfig
+from commefficient_tpu.federated.client_store import (HostArenaStore,
+                                                      make_codec)
 from commefficient_tpu.federated.round import (
     FedState, build_eval_step, build_round_step, init_fed_state)
 from commefficient_tpu.federated.state import (CLIENT_STATE_FIELDS,
@@ -106,21 +108,33 @@ class FedLearner:
         self.mesh = mesh
         self.state: FedState = init_fed_state(self.cfg, flat)
         # Host-offloaded client state (cfg.client_state_offload): the
-        # (num_clients, d) momentum/error/weight rows live in TPU-host
-        # pinned memory — bounded by host RAM like the reference's shm
-        # design (fed_aggregator.py:116-129) — and only the sampled rows
+        # momentum/error/weight rows live in mesh-sharded host arenas
+        # (client_store.HostArenaStore) — the row space block-partitioned
+        # along the mesh's 'clients' axis, each host shard owning its own
+        # contiguous arena — stored in the run's --client_state encoding
+        # (O(k) per row for sparse/sketched), and only the W sampled rows
         # move to device each round (round.build_round_step offload path).
         # Row movement runs through a double-buffered async pipeline
         # (HostOffloadPipeline): next-round gathers and last-round
-        # writebacks overlap the current round's compute.
+        # writebacks overlap the current round's compute, with each id
+        # routed to its owning shard's arena.
         self._offload = (self.cfg.client_state_offload
                          and self.cfg.has_client_state)
+        self.codec = make_codec(self.cfg)
         self.host_clients = None
+        self.host_store = None
         self._offload_pipe = None
         if self._offload:
             self._init_host_rows(flat)
             self._offload_pipe = HostOffloadPipeline(
                 self, depth=self.cfg.offload_pipeline_depth)
+            if mesh is None:
+                # the pipeline hands the round COMMITTED row stacks; with
+                # an uncommitted initial state the first round's outputs
+                # (donated back as the next state) would flip to committed
+                # and force a one-time recompile — commit up front so the
+                # round compiles exactly once (analysis/ retrace guard)
+                self.state = jax.device_put(self.state, self._s_dev)
         if mesh is not None:
             from commefficient_tpu.parallel.mesh import (batch_shardings,
                                                          shard_state)
@@ -197,40 +211,40 @@ class FedLearner:
         self.total_upload_bytes = 0.0
 
     def _init_host_rows(self, flat):
-        """Allocate per-client state rows host-side: pinned_host memory
-        when the backend supports it (TPU-host RAM — zero tunnel traffic
-        on remote chips; XLA's transfer engine streams rows over PCIe),
-        else plain numpy."""
+        """Allocate the host-side client state: one ``HostArenaStore`` of
+        per-shard numpy arenas, block-partitioned along the mesh's
+        'clients' axis (num_shards = that axis size; 1 off-mesh), each
+        row stored in the run's codec encoding.  Arenas live in plain
+        host RAM — contiguous per-shard blocks, so gathers are slices,
+        not per-row buffer hops (the old per-row pinned_host buffers
+        traded that locality away; docs/SCALING.md discusses when a
+        pinned staging buffer would still pay).  ``host_clients`` keeps
+        the historical per-field row-list interface as ``_ArenaView``s."""
         from jax.sharding import SingleDeviceSharding
-        dev = jax.devices()[0]
-        d = self.cfg.grad_dim
-        try:
-            self._s_dev = SingleDeviceSharding(dev)
-            self._s_host = SingleDeviceSharding(dev,
-                                                memory_kind="pinned_host")
-            jax.device_put(jnp.zeros((1,)), self._s_host)  # probe support
-            zero_dev = jnp.zeros((d,), jnp.float32)
-            # each device_put materializes a DISTINCT host buffer (rows
-            # evolve independently)
-            zero = lambda: jax.device_put(zero_dev, self._s_host)  # noqa
-        except Exception:
-            self._s_host = None
-            zero = lambda: np.zeros((d,), np.float32)  # noqa: E731
-        n = self.cfg.num_clients
-        self.host_clients = {
-            "velocities": ([zero() for _ in range(n)]
-                           if self.cfg.needs_velocity_state else None),
-            "errors": ([zero() for _ in range(n)]
-                       if self.cfg.needs_error_state else None),
-            # topk_down stale weights start as copies of the init weights
-            "weights": ([self._to_host(flat) for _ in range(n)]
-                        if self.cfg.needs_client_weights else None),
-        }
+        self._s_dev = SingleDeviceSharding(jax.devices()[0])
+        self._s_host = None
+        n_shards = (self.mesh.shape["clients"] if self.mesh is not None
+                    else 1)
+        fill = (np.asarray(flat) if self.cfg.needs_client_weights
+                else None)   # topk_down stale weights start at init weights
+        self.host_store = HostArenaStore(self.cfg, self.codec,
+                                         flat_weights=fill,
+                                         num_shards=n_shards)
+        self.host_clients = {f: self.host_store.view(f)
+                             for f in CLIENT_STATE_FIELDS}
+        if self.mesh is not None:
+            from commefficient_tpu.parallel.mesh import \
+                client_rows_shardings
+            self._rows_sh = client_rows_shardings(self.cfg, self.mesh)
+        else:
+            self._rows_sh = None
 
     def _to_host(self, x):
+        # rows may be encoded pytrees (dicts of leaves); map per leaf
         if self._s_host is not None:
-            return jax.device_put(x, self._s_host)
-        return np.asarray(x)
+            return jax.tree.map(lambda a: jax.device_put(a, self._s_host),
+                                x)
+        return jax.tree.map(np.asarray, x)
 
     def flush_offload(self):
         """Drain the offload pipeline: apply every pending host writeback
@@ -509,11 +523,16 @@ class FedLearner:
 class HostOffloadPipeline:
     """Double-buffered async gather/scatter of host-offloaded client rows.
 
-    The synchronous offload path serialized three stages per round:
-    host-gather the sampled (W, d) rows, run the jitted round, scatter the
-    output rows back — a device<->host transfer of up to 2 GB at GPT2
-    scale blocking every round. This pipeline takes both transfers off
-    the critical path:
+    Rows live in the learner's ``HostArenaStore`` — per-shard arenas
+    block-partitioned over the mesh's 'clients' axis, in the run's
+    ``--client_state`` encoding — and every gather/writeback here routes
+    each client id to its owning shard (``_ArenaView`` indexing goes
+    through ``HostArenaStore.owner``). The synchronous offload path
+    serialized three stages per round: host-gather the sampled W encoded
+    rows, run the jitted round, scatter the output rows back — a
+    device<->host transfer of up to 2 GB at GPT2 scale (dense encoding)
+    blocking every round. This pipeline takes both transfers off the
+    critical path:
 
     * **gather-ahead**: with the next round's pre-sampled client ids
       (``prefetch``), round t+1's input rows are stacked and put on
@@ -545,6 +564,27 @@ class HostOffloadPipeline:
     def __init__(self, learner: "FedLearner", depth: int = 2):
         self.learner = learner
         self.depth = max(1, int(depth))
+        # wire format of the rows crossing the round boundary: host-side
+        # codecs (dense/sparse) decode arena rows to dense (d,) on gather
+        # and encode on writeback — the jitted round sees dense rows and
+        # is representation-blind (the bitwise-equivalence contract);
+        # in-program codecs (sketched) ship the encoding itself
+        if learner.codec.host_side_offload:
+            self._arena_decode = learner.codec.decode_row_np
+            self._arena_encode = learner.codec.encode_row_np
+        else:
+            self._arena_decode = lambda row: row
+            self._arena_encode = lambda row: row
+        # a lossy codec (truncating sparse) must see pending-queue rows
+        # through the same encode/decode roundtrip an arena writeback
+        # applies — otherwise a gather's value would depend on whether a
+        # flush (e.g. a checkpoint drain) happened first, and
+        # checkpointing would silently perturb the trajectory
+        if learner.codec.wire_lossless:
+            self._wire_normalize = lambda row: row
+        else:
+            self._wire_normalize = lambda row: self._arena_decode(
+                self._arena_encode(jax.tree.map(np.asarray, row)))
         self._pending = deque()     # (ids_np, valid_np, out_rows) FIFO
         self._prefetched = None     # (key tuple, rows ClientState)
         self._pushes = 0            # pending-queue generation counter
@@ -555,10 +595,10 @@ class HostOffloadPipeline:
 
     # --- gather side -----------------------------------------------------
     def _resolve_row(self, field, cid, lst):
-        """Latest value of client ``cid``'s ``field`` row: the newest
-        pending (not yet written back) output row if one exists, else the
-        host row. Within a round the last valid slot wins, matching the
-        ascending-w host writeback order."""
+        """Latest value of client ``cid``'s ``field`` row (an encoded
+        pytree): the newest pending (not yet written back) output row if
+        one exists, else the arena row. Within a round the last valid
+        slot wins, matching the ascending-w host writeback order."""
         for ids_np, valid, out in reversed(self._pending):
             new = getattr(out, field)
             if new is None:
@@ -566,13 +606,19 @@ class HostOffloadPipeline:
             for w in range(len(ids_np) - 1, -1, -1):
                 if valid[w] and ids_np[w] == cid:
                     self.stats["rows_from_pending"] += 1
-                    return new[w], True
-        return lst[cid], False
+                    # pending rows are already in wire format; a lossy
+                    # codec still roundtrips them (flush-timing neutrality)
+                    return self._wire_normalize(
+                        jax.tree.map(lambda a: a[w], new)), True
+        return self._arena_decode(lst[cid]), False
 
     def _build_gather(self, ids_np):
-        """Stack the sampled clients' rows into (W, d) device arrays.
-        Out-of-range ids (padded epoch-tail slots) clamp like the device
-        gather would; their rows are inert (zero mask)."""
+        """Stack the sampled clients' encoded rows into W-leading device
+        arrays (per encoded leaf). Out-of-range ids (padded epoch-tail
+        slots) clamp like the device gather would; their rows are inert
+        (zero mask). On a mesh the stacked rows are placed per
+        ``client_rows_shardings`` — worker-dim sharded like the batch, so
+        each shard's devices receive the rows its own arena owns."""
         ln = self.learner
         t0 = time.perf_counter()
         fields = {}
@@ -589,14 +635,26 @@ class HostOffloadPipeline:
                 any_pending = any_pending or from_pending
                 picked.append(row)
             if ln._s_host is None and not any_pending:
-                # numpy host rows, nothing in flight: ONE stacked
-                # host->device transfer instead of W row puts
-                fields[field] = jnp.asarray(np.stack(picked))
+                # numpy arena rows, nothing in flight: ONE stacked
+                # host->device transfer per leaf instead of W row puts.
+                # Committed placement (device_put, not jnp.asarray) so the
+                # round sees the SAME input sharding as the pending-row
+                # path below — mixing committed and uncommitted rows
+                # would recompile the round on every path flip
+                stacked = jax.tree.map(
+                    lambda *rs: jax.device_put(np.stack(rs), ln._s_dev),
+                    *picked)
             else:
                 # device_put is a no-op for rows already on device
-                # (pending-queue slices); pinned-host rows transfer
-                picked = [jax.device_put(r, ln._s_dev) for r in picked]
-                fields[field] = jnp.stack(picked)
+                # (pending-queue slices)
+                picked = [jax.tree.map(
+                    lambda r: jax.device_put(r, ln._s_dev), row)
+                    for row in picked]
+                stacked = jax.tree.map(lambda *rs: jnp.stack(rs), *picked)
+            if ln.mesh is not None:
+                stacked = jax.device_put(stacked,
+                                         getattr(ln._rows_sh, field))
+            fields[field] = stacked
         self.stats["gathers"] += 1
         self.stats["gather_s"] += time.perf_counter() - t0
         return ClientState(**fields)
@@ -639,9 +697,13 @@ class HostOffloadPipeline:
             new = getattr(out, field)
             if lst is None or new is None:
                 continue
+            # one device->host transfer per leaf, then per-row numpy
+            # slices encoded into the owning shard's arena
+            new_np = jax.tree.map(np.asarray, new)
             for w, cid in enumerate(ids_np):
                 if valid[w] and 0 <= cid < len(lst):
-                    lst[int(cid)] = ln._to_host(new[w])
+                    lst[int(cid)] = self._arena_encode(
+                        jax.tree.map(lambda a: a[w], new_np))
         self.stats["flushed_rounds"] += 1
         self.stats["scatter_s"] += time.perf_counter() - t0
 

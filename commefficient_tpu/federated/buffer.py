@@ -57,10 +57,11 @@ import numpy as np
 from commefficient_tpu.config import FedConfig
 from commefficient_tpu.federated import client as client_lib
 from commefficient_tpu.federated.api import FedLearner, _dispatch_guard
+from commefficient_tpu.federated.client_store import (gather_rows,
+                                                      make_codec,
+                                                      scatter_rows)
 from commefficient_tpu.federated.faults import FaultModel
-from commefficient_tpu.federated.round import (FedState, _gather_rows,
-                                               _scatter_rows,
-                                               download_counts)
+from commefficient_tpu.federated.round import FedState, download_counts
 from commefficient_tpu.federated.server import make_sketch, server_update
 from commefficient_tpu.federated.state import BufferState, ClientState
 
@@ -88,6 +89,10 @@ def build_buffer_programs(apply_loss: Callable, unflatten: Callable,
         raise ValueError("build_buffer_programs needs server_mode="
                          f"'buffered', got {cfg.server_mode!r}")
     M = cfg.effective_buffer_m
+    # client rows live in codec-encoded storage (client_store.make_codec);
+    # buffer SLOTS stay dense — M is small — and rows encode only on the
+    # scatter back into client state at apply
+    codec = make_codec(cfg)
     sketch = make_sketch(cfg) if cfg.mode == "sketch" else None
     is_fedavg = cfg.mode == "fedavg"
     # same linearity fast path as the sync round: sketch once per APPLY
@@ -124,9 +129,9 @@ def build_buffer_programs(apply_loss: Callable, unflatten: Callable,
         stale_round = state.client_last_round[ids]
         counts = download_counts(state.last_changed, stale_round)   # (W,)
 
-        vels = _gather_rows(state.clients.velocities, ids)
-        errs = _gather_rows(state.clients.errors, ids)
-        stales = _gather_rows(state.clients.weights, ids)
+        vels = gather_rows(state.clients.velocities, ids, codec)
+        errs = gather_rows(state.clients.errors, ids, codec)
+        stales = gather_rows(state.clients.weights, ids, codec)
         rngs = jax.vmap(lambda i: jax.random.fold_in(rng, i))(ids)
         out = jax.vmap(
             one_client,
@@ -289,12 +294,12 @@ def build_buffer_programs(apply_loss: Callable, unflatten: Callable,
         scatter_ids = jnp.where(jnp.logical_and(contrib_b, ok), buf.cid,
                                 jnp.int32(num_clients))
         new_clients = ClientState(
-            velocities=_scatter_rows(state.clients.velocities,
-                                     scatter_ids, new_vels),
-            errors=_scatter_rows(state.clients.errors, scatter_ids,
-                                 buf.errors),
-            weights=_scatter_rows(state.clients.weights, scatter_ids,
-                                  buf.weights),
+            velocities=scatter_rows(state.clients.velocities,
+                                    scatter_ids, new_vels, codec),
+            errors=scatter_rows(state.clients.errors, scatter_ids,
+                                buf.errors, codec),
+            weights=scatter_rows(state.clients.weights, scatter_ids,
+                                 buf.weights, codec),
         )
 
         # stamps are in APPLY (version) units, same axis the download

@@ -84,10 +84,34 @@ class FaultModel:
         # slowest legitimate client)
         self.sync_timeout = (float(sync_timeout) if sync_timeout is not None
                              else self.base_latency * self.straggler_mult)
-        # chronic stragglers: a property of the CLIENT under this seed
-        self.straggler = np.array([
-            self._gen(_TAG_STRAGGLER, 0, c).random() < self.straggler_frac
-            for c in range(self.num_clients)])
+        # chronic stragglers: a property of the CLIENT under this seed,
+        # drawn LAZILY per sampled client (memoized). The historical eager
+        # (num_clients,) materialization made constructing a 1M-client
+        # model O(num_clients) before the first round ran; per-round cost
+        # must scale with the cohort width W (tests/test_client_store.py
+        # pins this via ``fate_draws``)
+        self._straggler_memo = {}
+        # per-(round, client) fate draws issued so far — the W-scaling
+        # guard: after R rounds of width W this is <= R * W, never a
+        # function of num_clients
+        self.fate_draws = 0
+
+    def _is_straggler(self, client: int) -> bool:
+        c = int(client) % self.num_clients
+        hit = self._straggler_memo.get(c)
+        if hit is None:
+            hit = self._straggler_memo[c] = bool(
+                self._gen(_TAG_STRAGGLER, 0, c).random()
+                < self.straggler_frac)
+        return hit
+
+    @property
+    def straggler(self):
+        """Full (num_clients,) chronic-straggler mask. Materializing it
+        draws every client — O(num_clients), analysis/test use only; the
+        fate path draws just the sampled ids."""
+        return np.array([self._is_straggler(c)
+                         for c in range(self.num_clients)])
 
     def _gen(self, tag: int, round_idx: int, client: int):
         """Order-independent stream: the counter IS the coordinates."""
@@ -97,12 +121,13 @@ class FaultModel:
         return np.random.Generator(bg)
 
     def fate(self, round_idx: int, client: int) -> ClientFate:
+        self.fate_draws += 1
         g = self._gen(_TAG_FATE, round_idx, client)
         # fixed draw order within the stream (part of the replay contract)
         u_drop, u_crash = g.random(), g.random()
         lat = g.lognormal(mean=np.log(self.base_latency),
                           sigma=self.latency_sigma)
-        if self.straggler[int(client) % self.num_clients]:
+        if self._is_straggler(client):
             lat *= self.straggler_mult
         if u_drop < self.dropout_prob:
             return ClientFate(False, False, np.inf)

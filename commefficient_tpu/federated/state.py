@@ -125,11 +125,16 @@ class ClientState:
 
     The reference allocates these as host shared-memory tensors of shape
     ``(num_clients, grad_size)`` or ``(num_clients, r, c)``
-    (fed_aggregator.py:116-129). Here they are device arrays sharded along
-    the leading ``clients`` axis of the mesh — or, under
-    ``client_state_offload``, per-client host rows streamed through
-    ``api.HostOffloadPipeline``. Fields are ``None`` when the run's mode
-    doesn't need them.
+    (fed_aggregator.py:116-129). Here each field holds the CODEC-ENCODED
+    storage chosen by ``cfg.client_state`` (federated/client_store.py):
+    dense keeps an ``(n, d)`` array leaf; sparse keeps an
+    ``{"idx": (n, k), "val": (n, k)}`` dict; sketched keeps
+    ``{"table": (n, r, c)}``. Under device placement the leaves are
+    device arrays sharded along the leading ``clients`` axis of the mesh;
+    under ``client_state_offload`` the rows live host-side in
+    ``client_store.HostArenaStore`` arenas (streamed through
+    ``api.HostOffloadPipeline``) and the device-side fields stay ``None``.
+    Fields are also ``None`` when the run's mode doesn't need them.
     """
     velocities: Optional[jax.Array] = None  # local momentum state
     errors: Optional[jax.Array] = None      # local error-feedback state

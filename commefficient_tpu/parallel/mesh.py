@@ -99,11 +99,26 @@ def fed_state_shardings(cfg: FedConfig, mesh: Mesh, axis: str = "clients"):
         opt_sh = _ns(mesh, None, m) if cols_divide else rep
     else:
         opt_sh = vec
-    clients = ClientState(
-        velocities=row if cfg.needs_velocity_state else None,
-        errors=row if cfg.needs_error_state else None,
-        weights=row if cfg.needs_client_weights else None,
-    )
+    if cfg.client_state_offload and cfg.has_client_state:
+        # host placement: rows live in the HostArenaStore's per-shard
+        # arenas (federated/client_store.py), so the device FedState
+        # carries no client rows at all
+        clients = ClientState()
+    else:
+        # the sharding tree must mirror the ENCODED storage structure
+        # (client_store.make_codec): the dense codec keeps (n, d) arrays
+        # — leading dim over the clients axis, coordinate dim over the
+        # model axis — while sparse/sketched leaves are O(k)-wide per
+        # row and shard their leading dim only
+        from commefficient_tpu.federated.client_store import make_codec
+        codec = make_codec(cfg)
+        enc_row = row if cfg.client_state == "dense" \
+            else codec.structure(_ns(mesh, axis))
+        clients = ClientState(
+            velocities=enc_row if cfg.needs_velocity_state else None,
+            errors=enc_row if cfg.needs_error_state else None,
+            weights=enc_row if cfg.needs_client_weights else None,
+        )
     return FedState(
         weights=vec,
         opt=ServerOptState(Vvelocity=opt_sh, Verror=opt_sh),
@@ -117,6 +132,31 @@ def fed_state_shardings(cfg: FedConfig, mesh: Mesh, axis: str = "clients"):
         # server_mode='buffered' is single-chip (federated/buffer.py
         # raises on a mesh), so the buffer subtree is always None here
         buffer=None,
+    )
+
+
+def client_rows_shardings(cfg: FedConfig, mesh: Mesh,
+                          axis: str = "clients"):
+    """Shardings for the offload round's W-leading encoded rows argument
+    (round.build_round_step, offload + mesh): rows travel with the batch —
+    leading worker dim over the ``clients`` axis, so each shard's devices
+    consume exactly the rows its own host arena gathered
+    (client_store.HostArenaStore block partition). Dense rows additionally
+    shard their coordinate dim over a ``model`` axis, matching
+    ``fed_state_shardings``'s row layout."""
+    from commefficient_tpu.federated.client_store import make_codec
+    codec = make_codec(cfg)
+    m = "model" if "model" in mesh.axis_names else None
+    dense_row = _ns(mesh, axis, m) if m else _ns(mesh, axis)
+    # host-side codecs (dense/sparse) hand the round dense (W, d) rows —
+    # the arena holds the encoding; only in-program codecs (sketched)
+    # ship their encoded structure across the boundary
+    enc_row = dense_row if codec.host_side_offload \
+        else codec.structure(_ns(mesh, axis))
+    return ClientState(
+        velocities=enc_row if cfg.needs_velocity_state else None,
+        errors=enc_row if cfg.needs_error_state else None,
+        weights=enc_row if cfg.needs_client_weights else None,
     )
 
 

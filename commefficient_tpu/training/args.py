@@ -112,11 +112,31 @@ def build_parser(default_lr: float = 0.4) -> argparse.ArgumentParser:
     p.add_argument("--iid", action="store_true", dest="do_iid")
     p.add_argument("--client_state_offload", action="store_true",
                    help="keep per-client momentum/error/weight rows in "
-                        "TPU-host pinned memory (bounded by host RAM, not "
-                        "HBM — the reference's shm design done TPU-"
-                        "natively); only the sampled rows move to device "
-                        "each round. Trajectory-identical; needed for "
-                        "local_topk at gpt2-small scale on one chip")
+                        "host arenas sharded across the mesh's 'clients' "
+                        "axis (bounded by aggregate host RAM, not HBM — "
+                        "the reference's shm design done TPU-natively); "
+                        "each host owns its row shard and only the W "
+                        "sampled rows move to device each round. "
+                        "Trajectory-identical; needed for local_topk at "
+                        "gpt2-small scale")
+    p.add_argument("--client_state", choices=("dense", "sparse", "sketched"),
+                   default="dense",
+                   help="per-client row REPRESENTATION (composes with "
+                        "--client_state_offload placement; federated/"
+                        "client_store.py): 'dense' stores full (d,) rows; "
+                        "'sparse' stores local_topk residuals as k "
+                        "(index, value) pairs — exact by construction, "
+                        "bitwise-identical trajectories under offload "
+                        "(tests/test_client_store.py); 'sketched' stores "
+                        "a per-client (rows, cols) CountSketch with "
+                        "bounded divergence. O(k)/O(r*c) per client "
+                        "instead of O(d) — the difference between 1M "
+                        "clients fitting in host RAM or not "
+                        "(docs/SCALING.md)")
+    p.add_argument("--client_sketch_rows", type=int, default=3,
+                   help="CountSketch rows r for --client_state sketched")
+    p.add_argument("--client_sketch_cols", type=int, default=128,
+                   help="CountSketch cols c for --client_state sketched")
     p.add_argument("--offload_pipeline_depth", type=int, default=2,
                    help="rounds of offloaded output rows that may queue "
                         "for lazy host writeback (api.HostOffloadPipeline)"

@@ -71,6 +71,20 @@ def config_fingerprint(args, entry: str) -> dict:
         v = getattr(args, f, None)
         fp[f] = v if (v is None or isinstance(v, (bool, int, float, str))
                       ) else str(v)
+    # the client-state REPRESENTATION changes the stored rows (and, on
+    # device placement, the compiled program), so resuming under a
+    # different one must fail loudly. Emitted only when non-dense: the
+    # fingerprint comparison is a set union over keys, so checkpoints
+    # written before the flag existed keep resuming under the dense
+    # default, while any dense<->sparse/sketched flip mismatches.
+    cs = getattr(args, "client_state", "dense")
+    if cs != "dense":
+        fp["client_state"] = cs
+        if cs == "sketched":
+            fp["client_sketch_rows"] = getattr(args, "client_sketch_rows",
+                                               None)
+            fp["client_sketch_cols"] = getattr(args, "client_sketch_cols",
+                                               None)
     return fp
 
 

@@ -28,6 +28,10 @@ Format history:
   relevant config, JSON) so ``--resume`` reproduces the uninterrupted
   trajectory bitwise. **v2 (and v1) files still load** — the new keys are
   optional on read, and the digest is only verified when present.
+  Encoded host-arena rows (``--client_state sparse|sketched`` under
+  offload) save each pytree leaf under a suffixed ``host_{field}__{leaf}``
+  key; dense arenas keep the original stacked ``host_{field}`` key, so
+  pre-existing dense checkpoints load unchanged.
 
 ``load_checkpoint`` is transactional: EVERY validation (digest, leaf paths,
 shapes, host-offload rows, config fingerprint) completes before the first
@@ -179,7 +183,17 @@ def save_checkpoint(path: str, learner, name: str = "model",
     host = getattr(learner, "host_clients", None)
     if host:
         for field, lst in host.items():
-            if lst is not None:
+            if lst is None:
+                continue
+            first = lst[0]
+            if isinstance(first, dict):
+                # encoded (sparse/sketched) arena rows are per-row pytree
+                # dicts; stack each leaf under its own suffixed key so the
+                # npz payload stays flat arrays
+                for lk in sorted(first):
+                    extra[f"host_{field}__{lk}"] = np.stack(
+                        [np.asarray(x[lk]) for x in lst])
+            else:
                 extra[f"host_{field}"] = np.stack(
                     [np.asarray(x) for x in lst])
     payload = dict(rounds_done=np.asarray(learner.rounds_done),
@@ -367,19 +381,27 @@ def load_checkpoint(fn: str, learner, expect_fingerprint: dict = None):
         for field, lst in host.items():
             if lst is None:
                 continue
-            key = f"host_{field}"
-            if key not in z:
-                raise ValueError(
-                    f"checkpoint {fn} is missing offloaded client "
-                    f"rows {key!r} — it was saved without "
-                    f"client_state_offload (config mismatch)")
-            arr = z[key]
-            want = (len(lst),) + tuple(np.shape(lst[0]))
-            if tuple(arr.shape) != want:
-                raise ValueError(
-                    f"checkpoint {fn} {key} has shape {arr.shape}, "
-                    f"learner expects {want} — config mismatch")
-            host_pending.append((lst, arr))
+            first = lst[0]
+            keys = ({lk: f"host_{field}__{lk}" for lk in sorted(first)}
+                    if isinstance(first, dict)
+                    else {None: f"host_{field}"})
+            leaves = {}
+            for lk, key in keys.items():
+                if key not in z:
+                    raise ValueError(
+                        f"checkpoint {fn} is missing offloaded client "
+                        f"rows {key!r} — it was saved without "
+                        f"client_state_offload or with a different "
+                        f"--client_state representation (config mismatch)")
+                arr = z[key]
+                row0 = first if lk is None else first[lk]
+                want = (len(lst),) + tuple(np.shape(row0))
+                if tuple(arr.shape) != want:
+                    raise ValueError(
+                        f"checkpoint {fn} {key} has shape {arr.shape}, "
+                        f"learner expects {want} — config mismatch")
+                leaves[lk] = arr
+            host_pending.append((lst, leaves))
     fingerprint = (json.loads(str(z["fingerprint"]))
                    if "fingerprint" in z else None)
     if expect_fingerprint is not None and fingerprint is not None:
@@ -396,9 +418,11 @@ def load_checkpoint(fn: str, learner, expect_fingerprint: dict = None):
     # ---- all validation passed; mutate ---------------------------------
     learner.state = jax.tree_util.tree_unflatten(
         treedef, [jax.numpy.asarray(x) for x in restored])
-    for lst, arr in host_pending:
+    for lst, leaves in host_pending:
         for i in range(len(lst)):
-            lst[i] = learner._to_host(arr[i])
+            row = (leaves[None][i] if None in leaves
+                   else {lk: a[i] for lk, a in leaves.items()})
+            lst[i] = learner._to_host(row)
     learner.rounds_done = int(z["rounds_done"])
     learner.total_download_bytes = float(z["total_download_bytes"])
     learner.total_upload_bytes = float(z["total_upload_bytes"])

@@ -34,7 +34,7 @@ def test_registry_covers_every_row():
     a row cannot exist in one mode and be silently skipped by the
     other."""
     names = [n for n, _ in bench._bench_rows()]
-    assert len(names) == len(set(names)) == 19
+    assert len(names) == len(set(names)) == 20
     for must in ("cifar10_resnet9_fed_rounds_per_sec",
                  "checkpoint_save_restore_overhead",
                  "gpt2_personachat_tokens_per_sec_chip_flash_attn",
@@ -45,6 +45,7 @@ def test_registry_covers_every_row():
                  "gpt2_fetchsgd_bucketed_rounds_t512_ab",
                  "gpt2_longcontext_4k_blockwise_tokens_per_sec_chip",
                  "offload_gather_scatter_overlap",
+                 "client_store_gather_scatter_1m",
                  "buffered_fedbuff_round_overhead",
                  "gpt2_decode_tokens_per_sec_chip_b1",
                  "gpt2_decode_tokens_per_sec_chip_b8",
@@ -93,6 +94,14 @@ def test_cli_glob_row_filter_matches_bucketed_rows(monkeypatch, capsys):
 
 def test_offload_row_traces_the_offload_round_signature(dry):
     out = bench.bench_offload_overlap()
+    assert out["dry_run"] == "ok"
+
+
+def test_client_store_row_traces_both_scales_with_sparse_arena(dry):
+    """The million-client row: both the 1e4 and 1e6 learners build, the
+    host arena stays O(n*k) (asserted inside the row), and the offload
+    round traces with its (W, d) dense row input."""
+    out = bench.bench_client_store_gather_scatter(scales=(120, 1_000_000))
     assert out["dry_run"] == "ok"
 
 

@@ -131,23 +131,48 @@ def test_offload_abort_keeps_host_rows_frozen():
         np.testing.assert_array_equal(host_row(ln, "errors", i), before[i])
 
 
-def test_offload_rejects_scan_and_mesh():
+def test_offload_rejects_scan():
     ln = make_learner(True, mode="local_topk", error_type="local", k=3)
     with pytest.raises(ValueError, match="scan_rounds=1"):
         ln.scan_window(4)
     with pytest.raises(ValueError, match="scan_rounds=1"):
         ln.train_rounds_scan(np.zeros((2, W), np.int32), (), ())
+
+
+def test_offload_on_mesh_matches_single_host():
+    # offload used to hard-raise on any mesh; the mesh-sharded arenas
+    # (federated/client_store.HostArenaStore) made it a supported
+    # placement — trajectories must match the single-host offload run
     from commefficient_tpu.training.args import parse_mesh
-    mesh = parse_mesh("clients=1")
-    with pytest.raises(ValueError, match="mesh"):
-        model = TinyMLP(num_classes=2, hidden=4)
-        cfg = FedConfig(mode="local_topk", error_type="local", k=3,
-                        weight_decay=0, num_workers=W,
-                        num_clients=N_CLIENTS, lr_scale=0.05,
-                        client_state_offload=True)
-        FedLearner(model, cfg, make_cv_loss(model), None,
-                   jax.random.PRNGKey(1),
-                   np.zeros((1, 8), np.float32), mesh=mesh)
+    cfg_kw = dict(mode="local_topk", error_type="local",
+                  local_momentum=0.9, k=3)
+    ln_one = make_learner(True, **cfg_kw)
+    model = TinyMLP(num_classes=2, hidden=4)
+    cfg = FedConfig(weight_decay=0, num_workers=W, num_clients=N_CLIENTS,
+                    lr_scale=0.05, client_state_offload=True, **cfg_kw)
+    mesh = parse_mesh("clients=2")
+    ln_mesh = FedLearner(model, cfg, make_cv_loss(model), None,
+                         jax.random.PRNGKey(1),
+                         np.random.RandomState(0).randn(1, 8)
+                         .astype(np.float32), mesh=mesh)
+    assert ln_mesh._offload
+    assert ln_mesh.host_store.num_shards == 2
+    for ids, batch, mask in rounds_data(3):
+        a = ln_one.train_round(ids, batch, mask)
+        b = ln_mesh.train_round(ids, batch, mask)
+        np.testing.assert_allclose(a["loss"], b["loss"], rtol=0, atol=1e-6)
+        assert a["upload_bytes"] == b["upload_bytes"]
+        assert a["download_bytes"] == b["download_bytes"]
+    np.testing.assert_allclose(np.asarray(ln_one.state.weights),
+                               np.asarray(ln_mesh.state.weights),
+                               rtol=0, atol=1e-6)
+    for i in range(N_CLIENTS):
+        np.testing.assert_allclose(host_row(ln_one, "errors", i),
+                                   host_row(ln_mesh, "errors", i),
+                                   rtol=0, atol=1e-6)
+    # ids were routed to their owning shards, not all to shard 0
+    assert ln_mesh.host_store.shard_reads.sum() > 0
+    assert ln_mesh.host_store.shard_writes.sum() > 0
 
 
 def test_offload_noop_without_client_state():

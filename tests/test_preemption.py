@@ -50,6 +50,16 @@ _CONFIGS = {
                      "--k", "5", "--client_state_offload"],
     "buffered": ["--mode", "local_topk", "--error_type", "local",
                  "--k", "5", "--server_mode", "buffered"],
+    # client-state representations (federated/client_store.py): the
+    # kill/restart contract is per-representation — encoded host arenas
+    # (sparse, offloaded) and per-client sketch tables (sketched, device)
+    # must restore bitwise, not just the dense rows
+    "sync_sparse": ["--mode", "local_topk", "--error_type", "local",
+                    "--k", "5", "--client_state", "sparse",
+                    "--client_state_offload"],
+    "sync_sketched": ["--mode", "local_topk", "--error_type", "local",
+                      "--k", "5", "--client_state", "sketched",
+                      "--client_sketch_cols", "32"],
 }
 
 
@@ -157,7 +167,8 @@ def test_crash_resume_smoke(tmp_path, sync_baseline):
     _kill_resume_roundtrip(tmp_path, "sync", sync_baseline)
 
 
-@pytest.mark.parametrize("cfg_key", ["sync_offload", "buffered"])
+@pytest.mark.parametrize("cfg_key", ["sync_offload", "buffered",
+                                     "sync_sparse", "sync_sketched"])
 def test_kill_resume_bitwise(tmp_path, tmp_path_factory, cfg_key):
     _kill_resume_roundtrip(tmp_path, cfg_key,
                            _baseline(tmp_path_factory, cfg_key))
