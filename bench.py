@@ -1125,6 +1125,117 @@ def bench_generate(batch=8, prompt_len=128, new_tokens=64,
     return B * N / decode_full, breakdown
 
 
+def bench_per_worker_sketch_ab(d=6_570_240, W=8, r=5, c=500_000):
+    """BENCH_r08 A/B: the per-worker vmapped sketch — exactly the
+    federated/client.py transmit shape, W workers' grads sketched under
+    one vmap with ``use_kernel=True`` — on the batched 2-D grid Pallas
+    kernel (forced 'kernel' dispatch; the natural choice on a TPU
+    backend) vs the vmapped XLA formulation (forced 'fallback' — the
+    pre-round-8 program). Deterministic device-cycle discipline: each arm
+    compiles and times inside its own ``force_dispatch`` context
+    back-to-back on the same chip, and the (W, r, c_eff) tables are
+    checked BITWISE-equal between arms before the ratio is reported.
+    Refutation is budgeted: a ratio below 1 is recorded as the measured
+    answer, not suppressed.
+
+    Dry-run: traces BOTH arms' programs on CPU and asserts the kernel
+    arm's jaxpr contains the pallas_call while the fallback arm's does
+    not — so a dispatch regression fails CI's trace, not just the
+    on-chip capture."""
+    import jax
+    import jax.numpy as jnp
+
+    from commefficient_tpu.ops import sketch_kernels
+    from commefficient_tpu.ops.countsketch import CountSketch
+
+    cs = CountSketch(d=d, c=c, r=r, seed=8, scheme="tiled")
+    assert sketch_kernels.kernel_supported(cs), (d, c, r)
+
+    def transmit(vs):
+        return jax.vmap(lambda v: cs.sketch_vec(v, True))(vs)
+
+    if DRY_RUN:
+        vecs = jax.ShapeDtypeStruct((W, d), jnp.float32)
+        for mode, want_kernel in (("kernel", True), ("fallback", False)):
+            with sketch_kernels.force_dispatch(mode):
+                out = jax.eval_shape(transmit, vecs)
+                assert out.shape == (W, cs.r, cs.c_eff), out.shape
+                has = "pallas_call" in str(jax.make_jaxpr(transmit)(vecs))
+                assert has == want_kernel, (mode, has)
+        return None, {"d": d, "W": W, "r": r, "c": c}
+
+    vecs = jnp.asarray(np.random.default_rng(0).standard_normal(
+        (W, d), dtype=np.float32))
+    ms, tables = {}, {}
+    for mode in ("kernel", "fallback"):
+        with sketch_kernels.force_dispatch(mode):
+            # compile AND time inside the context: force_dispatch clears
+            # jit caches at its edges, so each arm's program is fresh
+            fn = jax.jit(transmit)
+            out = fn(vecs)
+            _sync(out)
+            ms[mode] = _time(fn, vecs, n=5) * 1e3
+            tables[mode] = np.asarray(out)  # (W, r, c_eff): small
+    bitwise = bool(np.array_equal(tables["kernel"], tables["fallback"]))
+    assert bitwise, "batched kernel diverged from the XLA formulation"
+    return ms["fallback"] / ms["kernel"], {
+        "kernel_ms": round(ms["kernel"], 3),
+        "xla_ms": round(ms["fallback"], 3),
+        "bitwise_equal": bitwise, "d": d, "W": W, "r": r, "c": c}
+
+
+def bench_client_store_sketched_codec(d=6_570_240, W=8, r=3, c=128,
+                                      k=50_000):
+    """BENCH_r08: encode/decode cost of the sketched client-state codec
+    (client_store.SketchedCodec) under its two schemes — the incumbent
+    'global' per-coordinate layout vs 'tiled', whose W-row vmapped
+    encode/decode can dispatch the batched Pallas kernels. PR 11 chose
+    'global' on the ASSERTED claim that the tiled layout buys nothing at
+    the codec's small-c operating point; this row turns that into a
+    measurement (refutation budgeted — if tiled doesn't pay here,
+    'global' stays the default and the ratio lands in ROOFLINE.md as the
+    answer). Dry-run traces both schemes' encode+decode and asserts the
+    tiled encode reaches the batched kernel under forced dispatch."""
+    import jax
+    import jax.numpy as jnp
+
+    from commefficient_tpu.federated.client_store import SketchedCodec
+    from commefficient_tpu.ops import sketch_kernels
+
+    codecs = {s: SketchedCodec(d, r=r, c=c, k=k, seed=1, scheme=s)
+              for s in ("global", "tiled")}
+
+    if DRY_RUN:
+        rows = jax.ShapeDtypeStruct((W, d), jnp.float32)
+        for s, codec in codecs.items():
+            enc = jax.eval_shape(codec.encode_rows, rows)
+            assert enc["table"].shape == (W, codec.cs.r, codec.cs.c_eff)
+            dec = jax.eval_shape(codec.decode_rows, enc)
+            assert dec.shape == (W, d), dec.shape
+        with sketch_kernels.force_dispatch("kernel"):
+            jaxpr = str(jax.make_jaxpr(codecs["tiled"].encode_rows)(rows))
+        assert "pallas_call" in jaxpr, \
+            "tiled codec encode did not reach the batched kernel"
+        return None, {"d": d, "W": W, "r": r, "c": c, "k": k}
+
+    rows = jnp.asarray(np.random.default_rng(1).standard_normal(
+        (W, d), dtype=np.float32))
+    breakdown = {"d": d, "W": W, "r": r, "c": c, "k": k}
+    totals = {}
+    for s, codec in codecs.items():
+        enc_fn = jax.jit(codec.encode_rows)
+        enc = enc_fn(rows)
+        _sync(enc["table"])
+        t_enc = _time(enc_fn, rows, n=5) * 1e3
+        dec_fn = jax.jit(codec.decode_rows)
+        _sync(dec_fn(enc))
+        t_dec = _time(dec_fn, enc, n=5) * 1e3
+        breakdown[f"{s}_encode_ms"] = round(t_enc, 3)
+        breakdown[f"{s}_decode_ms"] = round(t_dec, 3)
+        totals[s] = t_enc + t_dec
+    return totals["global"] / totals["tiled"], breakdown
+
+
 #: lowercase substrings that mark an exception as a transient
 #: tunnel/remote-compile hiccup (the shared-chip failure modes that
 #: repeatedly zeroed whole bench artifacts — VERDICT r5 top item); shape
@@ -1208,6 +1319,14 @@ def _bench_rows():
          lambda: bench_offload_overlap()),
         ("client_store_gather_scatter_1m",
          lambda: bench_client_store_gather_scatter()),
+        ("cifar10_resnet9_per_worker_sketch_ab",
+         lambda: bench_per_worker_sketch_ab(d=6_570_240, W=8, r=5,
+                                            c=500_000)),
+        ("gpt2_fetchsgd_per_worker_sketch_ab",
+         lambda: bench_per_worker_sketch_ab(d=124_440_576, W=4, r=5,
+                                            c=500_000)),
+        ("client_store_sketched_codec",
+         lambda: bench_client_store_sketched_codec()),
         ("buffered_fedbuff_round_overhead",
          lambda: bench_buffered_rounds()),
         ("checkpoint_save_restore_overhead",
@@ -1267,13 +1386,14 @@ def main():
                     help="build every row's setup and trace its jitted "
                          "programs (jax.eval_shape) without compiling or "
                          "timing; exits nonzero if any row fails to trace")
-    ap.add_argument("--rows", default="",
-                    help="comma-separated substrings selecting rows "
-                         "(--dry-run only)")
+    ap.add_argument("--rows", action="append", default=None,
+                    help="row selector (substring or glob); repeatable "
+                         "and/or comma-separated (--dry-run only)")
     args = ap.parse_args()
 
     if args.dry_run:
-        raise SystemExit(1 if _dry_run_main(args.rows) else 0)
+        row_filter = ",".join(args.rows) if args.rows else ""
+        raise SystemExit(1 if _dry_run_main(row_filter) else 0)
 
     from commefficient_tpu.utils.logging import profile_ctx
 
@@ -1401,6 +1521,28 @@ def main():
                     "gather/scatter cost tracks cohort width W, arena "
                     "bytes track n*k — full breakdown at both 1e4 and "
                     "1e6 in config"}) if cstore is not None else None)
+    for label, dims in (("cifar10_resnet9", "d=6.57M W=8 r=5 c=500k"),
+                        ("gpt2_fetchsgd", "d=124.4M W=4 r=5 c=500k")):
+        pw = res[f"{label}_per_worker_sketch_ab"]
+        add(f"{label}_per_worker_sketch_ab",
+            round(pw[0], 4) if pw is not None else None, "speedup_x",
+            dict(pw[1], **{
+                "note": f"BENCH_r08: W vmapped per-worker sketches "
+                        f"({dims}) on the batched 2-D grid Pallas kernel "
+                        f"vs the forced XLA fallback — same chip, "
+                        f"back-to-back, tables checked bitwise-equal; "
+                        f"refutation budgeted (a ratio < 1 is the "
+                        f"measured answer)"}) if pw is not None else None)
+    codec_ab = res["client_store_sketched_codec"]
+    add("client_store_sketched_codec",
+        round(codec_ab[0], 4) if codec_ab is not None else None,
+        "speedup_x",
+        dict(codec_ab[1], **{
+            "note": "BENCH_r08: sketched client-state codec encode+decode, "
+                    "'global' (incumbent) vs 'tiled' (batched-kernel-"
+                    "eligible) scheme — PR 11's 'tiled buys nothing' claim "
+                    "measured; refutation budgeted, 'global' stays default "
+                    "unless tiled wins"}) if codec_ab is not None else None)
     ckpt = res["checkpoint_save_restore_overhead"]
     add("checkpoint_save_restore_overhead",
         ckpt["save_ms"] if ckpt is not None else None, "ms",

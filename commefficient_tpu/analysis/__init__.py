@@ -29,15 +29,16 @@ from .report import AuditReport, format_reports
 from .retrace import check_retrace
 from .rules import (DEFAULT_PATTERNS, DTYPE_ALLOW_PRIMITIVES,
                     HOST_BOUNDARY_PRIMITIVES, SCATTER_PRIMITIVES,
-                    BucketedTransmitRule, DtypeRule, FootprintRule,
-                    RuleReport, ShapePattern, TransferRule, Violation)
+                    BatchedSketchRule, BucketedTransmitRule, DtypeRule,
+                    FootprintRule, RuleReport, ShapePattern, TransferRule,
+                    Violation)
 from .targets import AuditTarget, build_targets, round_bucketed_target
 from .walker import EqnSite, WalkStats, collect_shapes, iter_eqns, walk
 
 __all__ = [
     "AuditReport", "AuditTarget", "BucketedTransmitRule", "DtypeRule",
-    "EqnSite", "FootprintRule", "RuleReport", "ShapePattern", "TransferRule",
-    "Violation", "WalkStats",
+    "BatchedSketchRule", "EqnSite", "FootprintRule", "RuleReport",
+    "ShapePattern", "TransferRule", "Violation", "WalkStats",
     "audit", "build_targets", "check_retrace", "collect_shapes",
     "format_reports", "iter_eqns", "lint_paths", "round_bucketed_target",
     "walk",

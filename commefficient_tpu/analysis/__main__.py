@@ -23,9 +23,9 @@ def main(argv=None) -> int:
         description="jaxpr-level invariant auditor (footprint / transfer / "
                     "retrace / dtype / prng)")
     parser.add_argument("--target", default="all",
-                        choices=["round", "round_bucketed", "buffered",
-                                 "client_store", "gpt2", "attention",
-                                 "sketch", "decode", "all"])
+                        choices=["round", "round_bucketed", "sketch_batched",
+                                 "buffered", "client_store", "gpt2",
+                                 "attention", "sketch", "decode", "all"])
     parser.add_argument("--no-retrace", action="store_true",
                         help="skip the (compile-heavy) retrace guards")
     parser.add_argument("--prng-lint", action="store_true",

@@ -290,6 +290,87 @@ class BucketedTransmitRule:
 
 
 # --------------------------------------------------------------------------
+# batched sketch kernel dispatch
+# --------------------------------------------------------------------------
+
+
+class BatchedSketchRule:
+    """The per-worker sketch runs ON the batched Pallas kernel, not the
+    vmapped XLA routing.
+
+    Round 8 made the sketch kernels batch-native: under the round's
+    per-worker vmap the custom_vmap guard dispatches the 2-D grid
+    ``(W, n_tiles)`` kernel instead of mapping the XLA formulation W
+    times. A refactor that reverts the guard (or a dispatch regression
+    in ``CountSketch._kernel_ok``) would be trajectory-identical — the
+    fallback is bit-identical per row — while silently restoring W
+    routing scatters per round; this rule pins the STRUCTURE:
+
+    * there must be >= 1 ``pallas_call`` whose OUTPUT is the batched
+      sketch table ``(W, r, c_eff)`` — the kernel inside the vmapped
+      transmit (interpret-mode pallas_call still appears as the
+      ``pallas_call`` primitive, so the tier-1 CPU walk sees it);
+    * no ``scatter-add`` may produce a ``(W, ...)`` table whose trailing
+      dims flatten to ``c_eff`` — that aval is the vmapped fallback in
+      either lowering (per-coordinate ``segment_sum`` -> ``(W, c_eff)``
+      on CPU, routed window ``segment_sum`` -> ``(W, nwindows, 128)`` on
+      TPU; both are the ``(W, ·)`` routing contraction the batched
+      kernel exists to remove).
+
+    ``W`` is a constructor argument, NOT an audit dim: the per-worker
+    path legitimately owns ``(W, d)`` grads, so binding W in ``dims``
+    would arm the footprint rule's (W, d) ban. Pick W distinct from r
+    (the target uses W=4 against r=3) so the server's own ``(r, c_eff)``
+    sketch-table eqns can't collide with the checked shapes.
+    """
+
+    name = "batched_sketch"
+
+    def __init__(self, W: int, r: int, c_eff: int):
+        self.W = int(W)
+        self.r = int(r)
+        self.c_eff = int(c_eff)
+
+    def check(self, sites: Sequence[EqnSite], stats: WalkStats,
+              dims: dict) -> RuleReport:
+        want = (self.W, self.r, self.c_eff)
+        report = RuleReport(
+            rule=self.name, ok=True,
+            notes=f"require pallas_call -> {want}; forbid scatter-add -> "
+                  f"(W={self.W}, ·)~{self.c_eff}")
+        kernel_hits = 0
+        for site in sites:
+            report.checked_eqns += 1
+            outs = [tuple(v.aval.shape) for v in site.eqn.outvars
+                    if hasattr(getattr(v, "aval", None), "shape")]
+            if site.primitive == "pallas_call":
+                if want in outs:
+                    kernel_hits += 1
+                continue
+            if site.primitive != "scatter-add":
+                continue
+            for shp in outs:
+                if (len(shp) >= 2 and shp[0] == self.W
+                        and int(np.prod(shp[1:])) == self.c_eff):
+                    report.ok = False
+                    report.violations.append(Violation(
+                        rule=self.name, path=site.path,
+                        primitive=site.primitive, shape=shp,
+                        message=f"vmapped XLA sketch routing {shp} — the "
+                                f"per-worker transmit fell off the "
+                                f"batched kernel"))
+        if kernel_hits == 0:
+            report.ok = False
+            report.violations.append(Violation(
+                rule=self.name, path="", primitive="<absent>",
+                message=f"no pallas_call producing the batched sketch "
+                        f"table {want} — the vmapped transmit is not on "
+                        f"the kernel"))
+        report.notes += f"; batched-kernel pallas_calls seen: {kernel_hits}"
+        return report
+
+
+# --------------------------------------------------------------------------
 # transfer
 # --------------------------------------------------------------------------
 

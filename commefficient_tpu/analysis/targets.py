@@ -24,8 +24,9 @@ import numpy as np
 
 from .report import AuditReport
 from .retrace import check_retrace
-from .rules import (DEFAULT_PATTERNS, BucketedTransmitRule, FootprintRule,
-                    RuleReport, ShapePattern, TransferRule)
+from .rules import (DEFAULT_PATTERNS, BatchedSketchRule,
+                    BucketedTransmitRule, FootprintRule, RuleReport,
+                    ShapePattern, TransferRule)
 from .walker import walk
 
 
@@ -215,6 +216,81 @@ def round_bucketed_target(variant: str = "local_topk",
                    plan.sizes, kind=kind, W=w,
                    c_eff=pad_cols(cfg_kw["num_cols"])
                    if kind == "sketch" else None)),
+        retrace=retrace)
+
+
+# --------------------------------------------------------------------------
+# batched per-worker sketch kernel dispatch (round 8)
+# --------------------------------------------------------------------------
+
+def sketch_batched_target(mutate: bool = False) -> AuditTarget:
+    """The per-worker transmit runs the BATCHED Pallas sketch kernel.
+
+    Traces a sketch round with ``max_grad_norm`` set — the sketch-space
+    clip is a per-worker nonlinearity, so ``round.build_round_step``
+    takes the NON-fused path and each worker sketches its own grad under
+    the round's worker vmap (federated/client.py) — and asserts via
+    :class:`BatchedSketchRule` that a ``pallas_call`` producing the
+    batched ``(W, r, c_eff)`` table appears INSIDE the vmapped transmit,
+    with no ``(W, ·)`` segment-sum routing contraction left.
+
+    Dispatch is forced with ``sketch_kernels.force_dispatch``: "kernel"
+    overrides the backend gate so the tier-1 CPU trace walks the real
+    kernel program (the Pallas interpreter executes it in the retrace
+    drives); ``mutate=True`` forces "fallback" — the pre-round-8 program
+    a guard revert would produce — and the audit must FAIL on it
+    (tests/test_analysis_audits.py pins this). The context manager
+    clears jit caches at both edges so neither mode's trace can be
+    served from the other's cache; within one mode the compile cache
+    must still stay at 1 (the retrace guard runs INSIDE the context).
+
+    W=4 (not the usual 3) so the checked ``(W, r, c_eff)=(4, 3, 256)``
+    and ``(W, c_eff)`` shapes cannot collide with the server's own
+    ``(r, c_eff)=(3, 256)`` sketch-table eqns. W is NOT bound in dims —
+    the per-worker path legitimately owns (W, d) grads.
+    """
+    from commefficient_tpu.ops import sketch_kernels
+    from commefficient_tpu.ops.countsketch import pad_cols
+
+    w, n_clients, hidden = 4, 7, 64
+    cfg_kw = dict(ROUND_CFGS["sketch"], num_cols=256, max_grad_norm=1.0)
+    mode = "fallback" if mutate else "kernel"
+    ln = _make_learner(num_workers=w, num_clients=n_clients, hidden=hidden,
+                       **cfg_kw)
+    d = int(ln.state.last_changed.shape[0])
+    batch, mask = _round_batch(w)
+    ids = jnp.arange(w, dtype=jnp.int32)
+
+    def trace():
+        with sketch_kernels.force_dispatch(mode):
+            return jax.make_jaxpr(ln._round.raw)(
+                ln.state, ids, batch, mask, jnp.float32(0.05),
+                jax.random.PRNGKey(0))
+
+    def retrace():
+        rng = np.random.RandomState(3)
+
+        def drive(i):
+            ids_i = rng.choice(n_clients, w, replace=False)
+            b, m = _round_batch(w, rng)
+            ln.train_round_async(ids_i, b, m)
+
+        # one context around warmup + every drive: force_dispatch clears
+        # jit caches at its edges, so entering per-drive would make the
+        # cache-stays-at-1 guard vacuous
+        with sketch_kernels.force_dispatch(mode):
+            return check_retrace(ln._round, None, repeats=3, warmup=1,
+                                 drive=drive)
+
+    return AuditTarget(
+        name="sketch_batched/per-worker" + ("(mutated)" if mutate else ""),
+        description=f"per-worker vmapped sketch on the batched kernel, "
+                    f"W={w}, d={d}, forced dispatch={mode}",
+        trace=trace,
+        dims={"num_clients": n_clients, "d": d},
+        rules=(FootprintRule(DEFAULT_PATTERNS), TransferRule(),
+               BatchedSketchRule(W=w, r=cfg_kw["num_rows"],
+                                 c_eff=pad_cols(cfg_kw["num_cols"]))),
         retrace=retrace)
 
 
@@ -630,15 +706,18 @@ def build_targets(name: str) -> list:
     if name == "round_bucketed":
         return [round_bucketed_target("local_topk"),
                 round_bucketed_target("sketch")]
+    if name == "sketch_batched":
+        return [sketch_batched_target()]
     if name == "decode":
         return [decode_target("step"), decode_target("generate")]
     if name == "client_store":
         return [client_store_target()]
     if name == "all":
         return (build_targets("round") + build_targets("round_bucketed")
+                + build_targets("sketch_batched")
                 + build_targets("buffered") + build_targets("client_store")
                 + build_targets("gpt2") + build_targets("attention")
                 + build_targets("sketch") + build_targets("decode"))
     raise ValueError(f"unknown audit target {name!r} (round|round_bucketed|"
-                     f"buffered|client_store|gpt2|attention|sketch|decode|"
-                     f"all)")
+                     f"sketch_batched|buffered|client_store|gpt2|attention|"
+                     f"sketch|decode|all)")

@@ -166,12 +166,14 @@ def compute_gradient(apply_loss, unflatten, forward_weights, batch, mask,
     # with no per-worker nonlinearity the sum of sketches equals the
     # sketch of the sum, so the round sketches once after aggregation
     if cfg.mode == "sketch" and sketch is not None:
-        # use_kernel is safe here even though client steps run under the
-        # round's per-worker vmap: the Pallas entry is batch-guarded
-        # (ops/sketch_kernels._batch_guard falls back to the bit-identical
-        # XLA formulation under vmap), so this opts in wherever the kernel
-        # can actually apply — e.g. a future unbatched per-client DP path —
-        # and costs nothing where it can't
+        # this call runs under the round's per-worker vmap, and on TPU
+        # backends it DISPATCHES the batched Pallas sketch kernel: the
+        # batch guard's custom_vmap rule (ops/sketch_kernels._batch_guard)
+        # selects the 2-D grid (W, n_tiles) variant, bit-identical per
+        # worker row to the XLA formulation, so all W sketches run on the
+        # kernel in one pallas_call. CPU, nested vmap, and over-budget
+        # shapes still fall back to the bit-identical XLA path — asserted
+        # by the sketch_batched graft-audit target (analysis/targets.py)
         g = sketch.sketch_vec(grad, use_kernel=True)
         if cfg.max_grad_norm is not None:
             # sketch-space clip via l2 estimate (ref fed_worker.py:317-319)

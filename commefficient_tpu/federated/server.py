@@ -98,7 +98,9 @@ def _sketched(sketched_grad, state, cfg, lr, sketch: CountSketch):
     # 'virtual' accumulates; 'none' recovers straight from the momentum table
     # (sketch+'local' is rejected by FedConfig.validate)
     err = state.Verror + v if cfg.error_type == "virtual" else v
-    # server-side (never vmapped): the Pallas estimate-all kernel is safe
+    # server-side, never vmapped: this estimate-all runs the UNBATCHED
+    # 1-D grid Pallas kernel (the round-8 batched variant serves the
+    # vmapped client.py/client_store.py paths, not this one)
     vals, idxs = topk_values_indices(sketch.estimates(err, use_kernel=True),
                                      cfg.k,
                                      cfg.topk_approx_recall or None)

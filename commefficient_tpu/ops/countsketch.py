@@ -294,23 +294,34 @@ class CountSketch:
 
     def _kernel_ok(self, use_kernel: bool) -> bool:
         """Pallas-kernel dispatch gate. The kernels are OPT-IN per call
-        site (``use_kernel=True``). They are batch-SAFE: each public entry
-        is wrapped in a ``custom_vmap`` whose batching rule abandons the
-        kernel for the bit-identical XLA formulation (sketch_kernels.
-        _batch_guard) — JAX's default pallas_call batching rule would
-        prepend the batch axis to the grid, turning ``pl.program_id(0)``
-        into the batch index and silently corrupting the tiling and the
-        sketch accumulator's step-0 init (review r4; the hazard that
-        previously kept the per-worker vmap paths off the kernel). Under
-        vmap the call therefore just doesn't get the kernel; unbatched
-        call sites (round.py sketch-after-aggregate, server.py unsketch)
-        get it as before."""
+        site (``use_kernel=True``) and BATCH-NATIVE: each public entry is
+        wrapped in a ``custom_vmap`` (sketch_kernels._batch_guard) whose
+        batching rule dispatches the purpose-built 2-D grid
+        ``(batch, n_tiles)`` kernel — per-row block specs, zero-init gated
+        on the tile index per batch row — instead of letting JAX's default
+        pallas_call batching rule prepend the batch axis to the grid and
+        turn ``pl.program_id(0)`` into the batch index (review r4: that
+        silently corrupts the tiling and the sketch accumulator's step-0
+        init, and is the hazard that kept the per-worker vmap paths off
+        the kernel until round 8). So the vmapped call sites — the
+        per-worker transmit (federated/client.py) and the sketched client
+        codec (federated/client_store.py) — now get the kernel too; the
+        XLA fallback remains for NESTED vmap, over-budget shapes, and
+        non-TPU backends. ``sketch_kernels.force_dispatch`` overrides the
+        backend gate for audits/benches (kernel mode runs the Pallas
+        interpreter off-TPU)."""
         if not use_kernel:
             return False
-        from commefficient_tpu.ops.sketch_kernels import kernel_supported
-        # the tunneled chip's backend can be named 'tpu' or 'axon'
-        return (kernel_supported(self)
-                and jax.default_backend() in ("tpu", "axon"))
+        from commefficient_tpu.ops.sketch_kernels import (
+            TPU_BACKENDS, forced_dispatch, kernel_supported)
+        forced = forced_dispatch()
+        if forced == "fallback":
+            return False
+        if not kernel_supported(self):
+            return False
+        if forced == "kernel":
+            return True
+        return jax.default_backend() in TPU_BACKENDS
 
     @partial(jax.jit, static_argnums=(0, 2))
     def sketch_vec(self, vec: jax.Array,
@@ -343,10 +354,11 @@ class CountSketch:
         reason.
 
         Dispatch mirrors ``sketch_vec``: Pallas kernel (offset-aware
-        grid) when ``use_kernel`` and eligible — measured 16.8 ms vs
-        24.9 ms for the XLA path at d=6.5M, 5x500k (quiet chip) — else
-        the XOR-butterfly routed formulation on TPU backends, else the
-        per-coordinate segment_sum on CPU/GPU.
+        grid; batch-native under vmap — see ``_kernel_ok``) when
+        ``use_kernel`` and eligible — measured 16.8 ms vs 24.9 ms for the
+        XLA path at d=6.5M, 5x500k (quiet chip) — else the XOR-butterfly
+        routed formulation on TPU backends, else the per-coordinate
+        segment_sum on CPU/GPU.
         """
         n = chunk.shape[0]
         if offset < 0 or offset + n > self.d:
@@ -417,16 +429,19 @@ class CountSketch:
     def estimates(self, table: jax.Array,
                   use_kernel: bool = False) -> jax.Array:
         """Median-of-rows unbiased estimates of all d coordinates."""
-        if self.scheme == "tiled" and self._use_routed():
+        if self.scheme == "tiled":
             # Pallas kernel: VMEM-resident table, per-block window slices,
             # in-register permute/sign/median — no permuted-copies
             # intermediate at all. Bit-identical (no reassociable sums;
-            # tests/test_sketch_kernels.py); opt-in per call site
-            # (_kernel_ok: the kernels are not vmap-safe).
+            # tests/test_sketch_kernels.py); opt-in per call site, and
+            # batch-native under vmap (_kernel_ok / _batch_guard). Checked
+            # ahead of _use_routed so a forced-kernel audit dispatches it
+            # on CPU too (via the Pallas interpreter).
             if self._kernel_ok(use_kernel):
                 from commefficient_tpu.ops.sketch_kernels import \
                     estimates_pallas
                 return estimates_pallas(self, table)
+        if self.scheme == "tiled" and self._use_routed():
             # Permuted-copies gather: materialize all 128 XOR-lane
             # permutations of the row's windows (L * c_eff floats, e.g.
             # 256 MB at c=500k), then each block's estimate is ONE

@@ -1,4 +1,4 @@
-"""Pallas TPU kernel for the CountSketch estimate-all path.
+"""Pallas TPU kernels for the CountSketch hot paths, batch-native.
 
 Round 3 measured the sketched round's remaining cost in the sketch
 pipeline, not the model (docs/ROOFLINE.md): at d=6.5M the estimate-all
@@ -17,14 +17,28 @@ to avoid scalar gathers. This kernel removes that intermediate entirely:
   sign multiply and the r=3/5 min-max median network — all in registers;
 * the only HBM traffic is the (d,) output write.
 
+Round 8 made both kernels BATCH-NATIVE: under ``vmap`` the custom_vmap
+rule (``_batch_guard``) dispatches a 2-D grid ``(batch, n_tiles)``
+variant with per-row block specs instead of abandoning the kernel, so
+the vmapped call sites — the per-worker transmit (federated/client.py)
+and the sketched client-state codec (federated/client_store.py) — run
+on the kernel too. Grid steps execute sequentially with the LAST axis
+fastest, so all of a batch row's tiles run back-to-back before the next
+row's: per row the accumulation order is identical to the unbatched
+kernel, and the VMEM budget is per-row (one table block + the tile
+temporaries are resident at a time), unchanged by the batch width.
+
 Bit-exactness: gather + multiply + min/max contain no reassociable
-summation, so the kernel output is BIT-IDENTICAL to
-``CountSketch.estimates`` (asserted in tests/test_sketch_kernels.py via
-interpret mode, and cheap to re-assert on-device).
+summation, and the scatter direction hits each window in ascending
+block order in both formulations, so kernel output is BIT-IDENTICAL to
+``CountSketch.estimates`` / ``sketch_range`` — per batch row too
+(asserted in tests/test_sketch_kernels.py via interpret mode, and cheap
+to re-assert on-device).
 """
 
 from __future__ import annotations
 
+from contextlib import contextmanager
 from functools import partial
 
 import jax
@@ -47,7 +61,50 @@ LANES = 128
 TILE_BLOCKS = 64
 VMEM_TABLE_BUDGET = 10 << 20  # leave headroom under ~16 MB VMEM
 
+#: the tunneled chip's backend can be named 'tpu' or 'axon'
+TPU_BACKENDS = ("tpu", "axon")
+
 _U = jnp.uint32
+
+#: trace-time dispatch override — see :func:`force_dispatch`
+_FORCED = None
+
+
+def forced_dispatch():
+    """Current dispatch override: "kernel", "fallback", or None."""
+    return _FORCED
+
+
+@contextmanager
+def force_dispatch(mode):
+    """Force CountSketch kernel dispatch while tracing/driving a program.
+
+    ``mode="kernel"`` makes ``CountSketch._kernel_ok`` ignore the backend
+    gate (the entry points below run via the Pallas interpreter off-TPU),
+    so the kernel program is traceable and executable on the CPU tier-1 —
+    this is how the ``sketch_batched`` graft-audit target traces the
+    production kernel dispatch without a chip. ``mode="fallback"`` forces
+    the XLA formulation everywhere — the audit's mutation, and the B side
+    of the per-worker bench A/B. ``mode=None`` restores backend-based
+    dispatch.
+
+    Clears the jit caches on entry AND exit: the override changes what a
+    call with identical shapes and statics traces to, and the inner
+    jitted CountSketch methods key their caches on (shapes, statics)
+    only — a cached program from the other mode must not leak across the
+    boundary.
+    """
+    global _FORCED
+    if mode not in (None, "kernel", "fallback"):
+        raise ValueError(f"mode must be kernel|fallback|None, got {mode!r}")
+    prev = _FORCED
+    jax.clear_caches()
+    _FORCED = mode
+    try:
+        yield
+    finally:
+        _FORCED = prev
+        jax.clear_caches()
 
 
 def _block_hash(coeffs_row, blk):
@@ -83,18 +140,22 @@ def _butterfly_xor(x, lanemask):
     return x
 
 
-def _batch_guard(kernel_call, xla_fallback):
-    """Batch-safe dispatch for a single-operand Pallas entry point.
+def _batch_guard(kernel_call, xla_fallback, batched_call=None):
+    """Batch-aware dispatch for a single-operand Pallas entry point.
 
     JAX's default pallas_call batching rule prepends the batch axis to
     the GRID, so under ``vmap`` ``pl.program_id(0)`` becomes the batch
     index: the tiling — and the sketch kernel's step-0 accumulator init —
-    would be silently wrong (the review-r4 hazard that used to make the
-    kernels a per-call-site opt-in the vmapped per-worker paths could
-    never take). This ``custom_vmap`` overrides that rule: a batched call
-    abandons the kernel and maps the bit-identical XLA formulation
-    instead, so ``use_kernel=True`` is safe everywhere and simply doesn't
-    get the kernel where it can't apply. Unbatched calls are untouched.
+    would be silently wrong (the review-r4 hazard). This ``custom_vmap``
+    overrides that rule: a batched call dispatches ``batched_call``, the
+    purpose-built 2-D grid ``(batch, n_tiles)`` kernel whose block specs
+    and init gate are batch-row-aware — NOT the default rule's mis-grid.
+    The XLA fallback remains for the cases the batched kernel does not
+    cover: ``batched_call=None`` (caller decided the shape is
+    unsupported/over-budget), and NESTED vmap — the batched entry is
+    itself guarded, so a second batching level maps the doubly-vmapped
+    XLA formulation instead of mis-gridding the 2-D kernel. Unbatched
+    calls are untouched.
     """
     run = jax.custom_batching.custom_vmap(kernel_call)
 
@@ -102,14 +163,28 @@ def _batch_guard(kernel_call, xla_fallback):
     def _rule(axis_size, in_batched, x):
         del axis_size
         (x_batched,) = in_batched
-        out = jax.vmap(xla_fallback)(x) if x_batched else xla_fallback(x)
-        return out, x_batched
+        if not x_batched:
+            return xla_fallback(x), False
+        if batched_call is None:
+            return jax.vmap(xla_fallback)(x), True
+        guarded = _batch_guard(batched_call,
+                               lambda xs: jax.vmap(xla_fallback)(xs))
+        return guarded(x), True
 
     return run
 
 
-def _estimates_kernel(table_ref, out_ref, win, *, coeffs, nwindows, r):
-    i0 = pl.program_id(0)
+def _interpret(flag: bool) -> bool:
+    """Run the Pallas interpreter off-TPU (CPU tests, forced-dispatch
+    audits) — the TPU lowering is only requested where a TPU is."""
+    return bool(flag) or jax.default_backend() not in TPU_BACKENDS
+
+
+def _estimates_kernel(table_ref, out_ref, win, *, coeffs, nwindows, r,
+                      batched):
+    # batched: 2-D grid (batch, n_tiles); program_id(0) is the batch row
+    # (blocks carry a leading length-1 batch dim), program_id(1) the tile
+    i0 = pl.program_id(1) if batched else pl.program_id(0)
 
     # phase 1 — scalar window gathers: each block's window base is a hash
     # of its block id; the 128-float window is one VMEM dynamic slice
@@ -118,7 +193,9 @@ def _estimates_kernel(table_ref, out_ref, win, *, coeffs, nwindows, r):
         for row in range(r):
             mb, _ = _block_hash(coeffs[row], blk)
             base = (mb % _U(nwindows)).astype(jnp.int32)
-            win[row, i, :] = table_ref[row, pl.ds(base * LANES, LANES)]
+            sl = pl.ds(base * LANES, LANES)
+            win[row, i, :] = table_ref[0, row, sl] if batched \
+                else table_ref[row, sl]
         return carry
 
     jax.lax.fori_loop(0, TILE_BLOCKS, body, 0)
@@ -133,7 +210,10 @@ def _estimates_kernel(table_ref, out_ref, win, *, coeffs, nwindows, r):
         _, lanemask = _block_hash(coeffs[row], blk_vec)
         signs = _signs(coeffs[row], idx)
         per_row.append(_butterfly_xor(win[row], lanemask) * signs)
-    out_ref[:, :] = _median(per_row)
+    if batched:
+        out_ref[0, :, :] = _median(per_row)
+    else:
+        out_ref[:, :] = _median(per_row)
 
 
 @partial(jax.jit, static_argnames=("cs", "interpret"))
@@ -141,14 +221,17 @@ def estimates_pallas(cs, table, interpret: bool = False):
     """All-coordinate estimates for a tiled-scheme CountSketch ``cs``.
 
     Drop-in for ``cs.estimates(table)`` when ``kernel_supported(cs)``;
-    ``interpret=True`` runs the Pallas interpreter (CPU tests). Batch-safe
-    (_batch_guard): a vmapped call maps ``cs.estimates`` instead."""
+    ``interpret=True`` runs the Pallas interpreter (implied off-TPU).
+    Batch-native (_batch_guard): a vmapped call dispatches the 2-D grid
+    (batch, n_tiles) kernel — per-row table blocks, bit-identical per
+    row; nested vmap maps the XLA ``cs.estimates`` instead."""
+    interp = _interpret(interpret)
     n_tiles = -(-cs.nblocks // TILE_BLOCKS)
 
     def kernel_call(tab):
         out = pl.pallas_call(
             partial(_estimates_kernel, coeffs=cs.coeffs,
-                    nwindows=cs.nwindows, r=cs.r),
+                    nwindows=cs.nwindows, r=cs.r, batched=False),
             grid=(n_tiles,),
             in_specs=[pl.BlockSpec((cs.r, cs.c_eff), lambda i: (0, 0),
                                    memory_space=pltpu.VMEM)],
@@ -158,36 +241,66 @@ def estimates_pallas(cs, table, interpret: bool = False):
                                            jnp.float32),
             scratch_shapes=[pltpu.VMEM((cs.r, TILE_BLOCKS, LANES),
                                        jnp.float32)],
-            interpret=interpret,
+            interpret=interp,
         )(tab)
         return out.reshape(-1)[:cs.d]
 
+    def batched_call(tabs):
+        B = tabs.shape[0]
+        out = pl.pallas_call(
+            partial(_estimates_kernel, coeffs=cs.coeffs,
+                    nwindows=cs.nwindows, r=cs.r, batched=True),
+            grid=(B, n_tiles),
+            in_specs=[pl.BlockSpec((1, cs.r, cs.c_eff),
+                                   lambda b, i: (b, 0, 0),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((1, TILE_BLOCKS, LANES),
+                                   lambda b, i: (b, i, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct(
+                (B, n_tiles * TILE_BLOCKS, LANES), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((cs.r, TILE_BLOCKS, LANES),
+                                       jnp.float32)],
+            interpret=interp,
+        )(tabs)
+        return out.reshape(B, -1)[:, :cs.d]
+
     return _batch_guard(kernel_call,
-                        lambda tab: cs.estimates(tab, use_kernel=False)
+                        lambda tab: cs.estimates(tab, use_kernel=False),
+                        batched_call if kernel_supported(cs) else None
                         )(table)
 
 
 def kernel_supported(cs) -> bool:
-    """The kernel handles the tiled scheme with an r=1/3/5 median network
-    and a table that fits the VMEM residency budget."""
+    """The kernels handle the tiled scheme with an r=1/3/5 median network
+    and a table that fits the VMEM residency budget. The budget is
+    PER-ROW and therefore batch-independent: the batched 2-D grid keeps
+    one batch row's table block plus the (r, TILE_BLOCKS, LANES) tile
+    temporaries resident per grid step, exactly like the unbatched
+    grid."""
     return (cs.scheme == "tiled" and cs.r in (1, 3, 5)
             and cs.r * cs.c_eff * 4 <= VMEM_TABLE_BUDGET)
 
 
 def _sketch_kernel(vec_ref, out_ref, win, *, coeffs, nwindows, r,
-                   block_offset):
+                   block_offset, batched):
     """Scatter direction: TPU grid steps run SEQUENTIALLY on a core, and
-    the output block's index_map is constant, so ``out_ref`` itself is the
-    VMEM-resident accumulator across steps (a separate scratch table
-    doubled VMEM and OOM'd at the 5x500k config) — the per-window '+='
-    needs no atomics. Additions hit each window in ascending block order —
-    the same order as the XLA paths (segment_sum groups by base in block
-    order; the XOR permutation guarantees one value per bucket per block),
-    so the result is bit-identical. ``block_offset`` shifts the GLOBAL
+    the output block's index_map is constant in the tile axis, so
+    ``out_ref`` itself is the VMEM-resident accumulator across steps (a
+    separate scratch table doubled VMEM and OOM'd at the 5x500k config) —
+    the per-window '+=' needs no atomics. Additions hit each window in
+    ascending block order — the same order as the XLA paths (segment_sum
+    groups by base in block order; the XOR permutation guarantees one
+    value per bucket per block), so the result is bit-identical.
+    ``batched``: 2-D grid (batch, n_tiles), the LAST axis fastest — a
+    row's tiles run back-to-back, so the zero-init is gated on the TILE
+    index (``pl.program_id(1) == 0``, once per batch row as its output
+    block comes into residency) and per row the accumulation order is
+    exactly the unbatched kernel's. ``block_offset`` shifts the GLOBAL
     block ids the hashes key on: the grid covers one transmit bucket's
-    blocks (countsketch.sketch_range) while every contribution still lands
-    in the cell the monolithic sketch would put it."""
-    i0 = pl.program_id(0)
+    blocks (countsketch.sketch_range) while every contribution still
+    lands in the cell the monolithic sketch would put it."""
+    i0 = pl.program_id(1) if batched else pl.program_id(0)
 
     @pl.when(i0 == 0)
     def _():
@@ -199,7 +312,7 @@ def _sketch_kernel(vec_ref, out_ref, win, *, coeffs, nwindows, r,
                + jax.lax.broadcasted_iota(_U, (TILE_BLOCKS, LANES), 0))
     lane = jax.lax.broadcasted_iota(_U, (TILE_BLOCKS, LANES), 1)
     idx = blk_vec * _U(LANES) + lane
-    x = vec_ref[:, :]
+    x = vec_ref[0, :, :] if batched else vec_ref[:, :]
     for row in range(r):
         _, lanemask = _block_hash(coeffs[row], blk_vec)
         win[row, :, :] = _butterfly_xor(x * _signs(coeffs[row], idx),
@@ -212,7 +325,10 @@ def _sketch_kernel(vec_ref, out_ref, win, *, coeffs, nwindows, r,
             mb, _ = _block_hash(coeffs[row], blk)
             base = (mb % _U(nwindows)).astype(jnp.int32)
             sl = pl.ds(base * LANES, LANES)
-            out_ref[row, sl] = out_ref[row, sl] + win[row, i, :]
+            if batched:
+                out_ref[0, row, sl] = out_ref[0, row, sl] + win[row, i, :]
+            else:
+                out_ref[row, sl] = out_ref[row, sl] + win[row, i, :]
         return carry
 
     jax.lax.fori_loop(0, TILE_BLOCKS, body, 0)
@@ -225,20 +341,31 @@ def sketch_vec_pallas(cs, vec, interpret: bool = False,
 
     ``vec`` may be a bucket slice shorter than d; ``block_offset`` is its
     first coordinate's block id (countsketch.sketch_range dispatches
-    ``offset // 128``). Batch-safe (_batch_guard): a vmapped call maps the
-    XLA sketch_range instead of mis-gridding the kernel."""
+    ``offset // 128``). Batch-native (_batch_guard): a vmapped call
+    dispatches the 2-D grid (batch, n_tiles) kernel — per-row input and
+    accumulator blocks, zero-init on each row's first tile — bit-identical
+    per row to the unbatched kernel and to the XLA formulation; nested
+    vmap maps the XLA sketch_range instead of mis-gridding."""
     n = vec.shape[0]
+    if n == 0:
+        # a zero-length slice sketches to the zero table (the XLA paths'
+        # empty segment_sum); a 0-tile grid would leave the accumulator
+        # uninitialized, so never reach the kernel
+        return jnp.zeros((cs.r, cs.c_eff), jnp.float32)
+    interp = _interpret(interpret)
     n_blocks = -(-n // LANES)
     n_tiles = -(-n_blocks // TILE_BLOCKS)
 
-    def kernel_call(v):
+    def _padded(v):
         # zero-pad so tail-tile blocks contribute exact zeros to their
         # windows
-        vp = jnp.pad(v, (0, n_tiles * TILE_BLOCKS * LANES - n)
-                     ).reshape(n_tiles * TILE_BLOCKS, LANES)
+        return jnp.pad(v, (0, n_tiles * TILE_BLOCKS * LANES - n)
+                       ).reshape(n_tiles * TILE_BLOCKS, LANES)
+
+    def kernel_call(v):
         return pl.pallas_call(
             partial(_sketch_kernel, coeffs=cs.coeffs, nwindows=cs.nwindows,
-                    r=cs.r, block_offset=block_offset),
+                    r=cs.r, block_offset=block_offset, batched=False),
             grid=(n_tiles,),
             in_specs=[pl.BlockSpec((TILE_BLOCKS, LANES), lambda i: (i, 0),
                                    memory_space=pltpu.VMEM)],
@@ -248,10 +375,33 @@ def sketch_vec_pallas(cs, vec, interpret: bool = False,
             scratch_shapes=[
                 pltpu.VMEM((cs.r, TILE_BLOCKS, LANES), jnp.float32),
             ],
-            interpret=interpret,
+            interpret=interp,
+        )(_padded(v))
+
+    def batched_call(vs):
+        B = vs.shape[0]
+        vp = jax.vmap(_padded)(vs)
+        return pl.pallas_call(
+            partial(_sketch_kernel, coeffs=cs.coeffs, nwindows=cs.nwindows,
+                    r=cs.r, block_offset=block_offset, batched=True),
+            grid=(B, n_tiles),
+            in_specs=[pl.BlockSpec((1, TILE_BLOCKS, LANES),
+                                   lambda b, i: (b, i, 0),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((1, cs.r, cs.c_eff),
+                                   lambda b, i: (b, 0, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((B, cs.r, cs.c_eff),
+                                           jnp.float32),
+            scratch_shapes=[
+                pltpu.VMEM((cs.r, TILE_BLOCKS, LANES), jnp.float32),
+            ],
+            interpret=interp,
         )(vp)
 
     return _batch_guard(
         kernel_call,
-        lambda v: cs.sketch_range(v, block_offset * LANES, use_kernel=False)
+        lambda v: cs.sketch_range(v, block_offset * LANES,
+                                  use_kernel=False),
+        batched_call if kernel_supported(cs) else None,
     )(vec)

@@ -65,6 +65,39 @@ def test_round_bucketed_audit_passes_with_retrace(audited, idx, variant):
     assert rep.rule("bucketed").ok
 
 
+def test_sketch_batched_audit_passes_with_retrace(audited):
+    """The per-worker sketch round (max_grad_norm forces the non-fused
+    path) runs the BATCHED Pallas sketch kernel inside the worker vmap:
+    a pallas_call producing the (W, r, c_eff) table, no (W, ·) routing
+    scatter — and the compile cache stays at 1 across drives under
+    force_dispatch('kernel') (one context around warmup + drives, so the
+    guard is not vacuous)."""
+    rep = audited("sketch_batched", 0, with_retrace=True)
+    assert rep.target == "sketch_batched/per-worker"
+    assert rep.ok, rep.format()
+    bs = rep.rule("batched_sketch")
+    assert bs.ok and "pallas_calls seen: 1" in bs.notes
+    assert rep.stats.visited("pallas_call"), rep.stats.descended_into
+
+
+def test_sketch_batched_audit_fails_under_forced_fallback():
+    """Mutation: the SAME round traced with force_dispatch('fallback') —
+    the program a batch-guard revert would produce — must FAIL the
+    batched_sketch rule, with the vmapped (W, c_eff) routing scatter
+    named in the violations.  This is what makes the PASS at HEAD
+    meaningful."""
+    from commefficient_tpu.analysis.targets import sketch_batched_target
+
+    rep = sketch_batched_target(mutate=True).audit(with_retrace=False)
+    assert rep.target == "sketch_batched/per-worker(mutated)"
+    assert not rep.ok
+    bs = rep.rule("batched_sketch")
+    assert not bs.ok
+    msgs = " ".join(v.message for v in bs.violations)
+    assert "vmapped XLA sketch routing" in msgs
+    assert "no pallas_call" in msgs
+
+
 def test_gpt2_train_step_audit_passes_and_visits_remat(audited):
     rep = audited("gpt2")
     assert rep.ok, rep.format()

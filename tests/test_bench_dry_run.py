@@ -34,8 +34,11 @@ def test_registry_covers_every_row():
     a row cannot exist in one mode and be silently skipped by the
     other."""
     names = [n for n, _ in bench._bench_rows()]
-    assert len(names) == len(set(names)) == 20
+    assert len(names) == len(set(names)) == 23
     for must in ("cifar10_resnet9_fed_rounds_per_sec",
+                 "cifar10_resnet9_per_worker_sketch_ab",
+                 "gpt2_fetchsgd_per_worker_sketch_ab",
+                 "client_store_sketched_codec",
                  "checkpoint_save_restore_overhead",
                  "gpt2_personachat_tokens_per_sec_chip_flash_attn",
                  "flash_attn_t256_parity_dropout_kernel_ab",
@@ -112,6 +115,51 @@ def test_decode_row_traces_prefill_generate_and_ab(dry):
     status, breakdown = bench.bench_generate(batch=1, ab_uncached=True)
     assert status["dry_run"] == "ok"
     assert breakdown == {}
+
+
+def test_per_worker_sketch_ab_row_traces_both_arms(dry):
+    """The BENCH_r08 A/B row traces BOTH dispatch arms on CPU and
+    asserts the kernel arm's jaxpr carries the pallas_call while the
+    fallback arm's does not — the dispatch-regression trace gate."""
+    speedup, info = bench.bench_per_worker_sketch_ab(
+        d=131_072, W=4, r=3, c=1_024)
+    assert speedup is None
+    assert info == {"d": 131_072, "W": 4, "r": 3, "c": 1_024}
+
+
+def test_sketched_codec_row_traces_both_schemes(dry):
+    """The codec A/B row traces encode+decode under both schemes and
+    pins that the tiled encode reaches the batched kernel under forced
+    dispatch."""
+    speedup, info = bench.bench_client_store_sketched_codec(
+        d=4_096, W=3, r=3, c=128, k=64)
+    assert speedup is None
+    assert info["k"] == 64
+
+
+def test_cli_repeated_rows_flags_union_round8_selectors(monkeypatch,
+                                                        capsys):
+    """CI passes --rows twice ('*per_worker_sketch*' then
+    '*sketched_codec*'); the flags must UNION (argparse append), not
+    last-one-wins. Row bodies are stubbed — this pins the SELECTION."""
+    calls = []
+    monkeypatch.setattr(bench, "bench_per_worker_sketch_ab",
+                        lambda **kw: calls.append(kw["d"]))
+    monkeypatch.setattr(bench, "bench_client_store_sketched_codec",
+                        lambda **kw: calls.append("codec"))
+    monkeypatch.setattr(sys, "argv",
+                        ["bench.py", "--dry-run",
+                         "--rows", "*per_worker_sketch*",
+                         "--rows", "*sketched_codec*"])
+    with pytest.raises(SystemExit) as ex:
+        bench.main()
+    assert ex.value.code == 0
+    out = capsys.readouterr().out
+    assert calls == [6_570_240, 124_440_576, "codec"]
+    assert "cifar10_resnet9_per_worker_sketch_ab" in out
+    assert "gpt2_fetchsgd_per_worker_sketch_ab" in out
+    assert "client_store_sketched_codec" in out
+    assert "client_store_gather_scatter_1m" not in out
 
 
 def test_cli_dry_run_filters_rows_and_exits_zero(monkeypatch, capsys):

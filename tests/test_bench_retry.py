@@ -145,6 +145,15 @@ def test_main_emits_json_and_exits_zero_despite_failed_metrics(
             "amortized_overhead_pct": 0.015,
             "checkpoint_every_rounds": every_rounds})
 
+    monkeypatch.setattr(
+        bench, "bench_per_worker_sketch_ab",
+        lambda d, W, r, c: (1.4, {"kernel_ms": 5.0, "xla_ms": 7.0,
+                                  "bitwise_equal": True,
+                                  "d": d, "W": W, "r": r, "c": c}))
+    monkeypatch.setattr(
+        bench, "bench_client_store_sketched_codec",
+        lambda: (1.05, {"global_total_ms": 10.0, "tiled_total_ms": 9.5}))
+
     def dead(*a, **k):
         raise RuntimeError("UNAVAILABLE: tunnel read body")
 
@@ -166,6 +175,9 @@ def test_main_emits_json_and_exits_zero_despite_failed_metrics(
     assert "gpt2_fetchsgd_bucketed_rounds_t512_ab" in metrics
     assert "gpt2_fused_ce_t512_ab" in metrics
     assert "checkpoint_save_restore_overhead" in metrics
+    assert "cifar10_resnet9_per_worker_sketch_ab" in metrics
+    assert "gpt2_fetchsgd_per_worker_sketch_ab" in metrics
+    assert "client_store_sketched_codec" in metrics
     # the dead metrics are absent from the numbers but present in errors
     assert "gpt2_fetchsgd_sketch_rounds_per_sec" not in metrics
     failed = {e["metric"] for e in out["errors"]}
