@@ -25,7 +25,8 @@ def main(argv=None) -> int:
     parser.add_argument("--target", default="all",
                         choices=["round", "round_bucketed", "sketch_batched",
                                  "buffered", "client_store", "gpt2",
-                                 "attention", "sketch", "decode", "all"])
+                                 "attention", "sketch", "decode",
+                                 "decode_paged", "all"])
     parser.add_argument("--no-retrace", action="store_true",
                         help="skip the (compile-heavy) retrace guards")
     parser.add_argument("--prng-lint", action="store_true",

@@ -195,9 +195,13 @@ class BucketedTransmitRule:
       producing a ``(c_eff,)`` table row from the bucket's ``(s,)``
       chunk — and no ``(c_eff,)``-producing scatter-add may consume a
       full ``(d,)`` updates vector (the monolithic ``sketch_vec``).
-      Both tests are gated on the ``(c_eff,)`` OUTPUT shape: the server's
+      Both tests are gated on the table-row OUTPUT shape: the server's
       unsketch legitimately scatters k values into a ``(d,)``
       accumulator, so a bare operand-shape check would false-positive.
+      The round-8 batch-guard dispatch lowers ``sketch_vec`` through a
+      singleton vmap, so the table row (and its updates vector) may
+      carry one leading batch axis: ``(B, c_eff)`` consuming ``(B, d)``
+      is the same monolithic sketch and is matched too.
 
     ``W`` is a constructor argument, NOT an audit dim: binding ``W`` in
     ``dims`` would arm the footprint rule's (W, d) ban, which must stay
@@ -262,9 +266,11 @@ class BucketedTransmitRule:
                 if site.primitive != "scatter-add":
                     continue
                 ins, outs = self._shapes(site.eqn)
-                if not outs or outs[0] != (self.c_eff,):
+                out = outs[0] if outs else None
+                if out is None or len(out) > 2 or out[-1] != self.c_eff:
                     continue
-                if (d,) in ins:
+                lead = out[:-1]  # () plain, or (B,) under the batch guard
+                if (d,) in ins or lead + (d,) in ins:
                     report.ok = False
                     report.violations.append(Violation(
                         rule=self.name, path=site.path,
@@ -274,7 +280,7 @@ class BucketedTransmitRule:
                                 f"sketch_range"))
                 else:
                     for s in self.sizes:
-                        if (s,) in ins:
+                        if (s,) in ins or lead + (s,) in ins:
                             per_size[s] += 1
         missing = [s for s, n in per_size.items() if n == 0]
         if missing:

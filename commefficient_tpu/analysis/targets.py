@@ -644,6 +644,95 @@ def decode_target(program: str = "step") -> AuditTarget:
         retrace=retrace)
 
 
+def decode_paged_target(mutate: bool = False) -> AuditTarget:
+    """The block-paged serving step (serving/paged_cache.py + the
+    ``paged_step`` program in serving/decode.py).
+
+    The paged contract is that per-slot KV state lives ONLY in the page
+    pools — ``(num_pages, page_size, H, hd)`` per layer — reached
+    through the traced page table, so a ``(slots, max_len, H, hd)`` aval
+    anywhere in the step is the dense per-slot cache slab leaking back
+    in (the exact HBM reservation paging exists to remove), and a
+    ``(slots, max_len)`` aval is its one-hot position-write mask.  The
+    rule is STRICT (no allowlist): the paged program's gathered pages
+    stay 5-D end to end (ops/attention.paged_decode_attention), so no
+    legitimate eqn carries either shape.  The transfer rule proves the
+    host bookkeeping (free lists, refcounts, prefix sharing) stays
+    between steps, and the retrace guard drives the step through a REAL
+    paged server — admissions, evictions, page-boundary crossings and
+    shared prompt pages — asserting the compile cache stays flat.
+
+    ``mutate=True`` traces the dense fixed-slot step at the same dims —
+    the program a dense-slab reintroduction would produce — and the
+    audit must FAIL on it (tests/test_paged_serving.py pins this).
+    """
+    engine, S = _decode_engine()
+    B = 3
+    cfg = engine.model.config
+    page_size = 8
+    tok = jnp.asarray(np.full((B,), 5, np.int32))
+    typ = jnp.asarray(np.full((B,), 7, np.int32))
+    pos = jnp.asarray(np.array([3, 9, 1], np.int32))
+    rng0 = jax.random.PRNGKey(2)
+    done = jnp.zeros((B,), bool)
+    max_pages = S // page_size
+    num_pages = 1 + B * max_pages
+
+    if mutate:
+        def trace():
+            return jax.make_jaxpr(engine._step_raw)(
+                engine.params, engine.init_cache(B), tok, typ, pos,
+                rng0, done)
+    else:
+        def trace():
+            pools = engine.init_paged_pools(num_pages, page_size)
+            pt = jnp.zeros((B, max_pages), jnp.int32)
+            return jax.make_jaxpr(engine._paged_step_raw)(
+                engine.params, pools, pt, tok, typ, pos, rng0, done)
+
+    def retrace():
+        from commefficient_tpu.serving import ContinuousBatchingServer
+        srv = ContinuousBatchingServer(engine, slots=B, prefill_len=16,
+                                       kv_cache="paged",
+                                       page_size=page_size)
+        rs = np.random.RandomState(31)
+        V = cfg.vocab_size
+        shared = [int(t) for t in rs.randint(0, V - 1, 16)]
+
+        def drive(i):
+            if len(srv._queue) < 2:
+                # two sharers of the same 2-page prompt + a private one:
+                # every step sees a fresh page table (admission churn,
+                # refcounted shared pages, frontier allocations)
+                srv.submit(shared, [7] * 16, 7, 5)
+                srv.submit(shared, [7] * 16, 7, 3)
+                pl = int(rs.randint(3, 12))
+                srv.submit([int(t) for t in rs.randint(0, V - 1, pl)],
+                           [7] * pl, 7, 4)
+            srv.step()
+
+        return check_retrace(engine.paged_step, None, repeats=3,
+                             warmup=1, drive=drive)
+
+    slab = ShapePattern(("slots", "max_len", "H", "hd"),
+                        label="dense per-slot KV cache slab",
+                        allow_primitives=frozenset())
+    posmask = ShapePattern(("slots", "max_len"),
+                           label="dense per-slot position mask",
+                           allow_primitives=frozenset())
+    return AuditTarget(
+        name="decode_paged/step" + ("(mutated)" if mutate else ""),
+        description="block-paged decode step against page pools + traced "
+                    "page table; strict no-(slots, max_len, H, hd) ban"
+                    + (" [dense-slab mutation — must fail]"
+                       if mutate else ""),
+        trace=trace,
+        dims={"slots": B, "max_len": S, "H": cfg.n_head,
+              "hd": cfg.n_embd // cfg.n_head},
+        rules=(FootprintRule((slab, posmask)), TransferRule()),
+        retrace=retrace)
+
+
 # --------------------------------------------------------------------------
 # sketch ops
 # --------------------------------------------------------------------------
@@ -710,6 +799,8 @@ def build_targets(name: str) -> list:
         return [sketch_batched_target()]
     if name == "decode":
         return [decode_target("step"), decode_target("generate")]
+    if name == "decode_paged":
+        return [decode_paged_target()]
     if name == "client_store":
         return [client_store_target()]
     if name == "all":
@@ -717,7 +808,8 @@ def build_targets(name: str) -> list:
                 + build_targets("sketch_batched")
                 + build_targets("buffered") + build_targets("client_store")
                 + build_targets("gpt2") + build_targets("attention")
-                + build_targets("sketch") + build_targets("decode"))
+                + build_targets("sketch") + build_targets("decode")
+                + build_targets("decode_paged"))
     raise ValueError(f"unknown audit target {name!r} (round|round_bucketed|"
                      f"sketch_batched|buffered|client_store|gpt2|attention|"
-                     f"sketch|decode|all)")
+                     f"sketch|decode|decode_paged|all)")

@@ -111,6 +111,14 @@ class FedConfig:
     # error table; ops/countsketch.py 'global' scheme).
     client_sketch_rows: int = 3
     client_sketch_cols: int = 128
+    # Serve per-user weight deltas straight out of the client state store
+    # (serving/personalize.py): each admitted request's user row is
+    # applied to the served params as a sparse O(k) delta and removed at
+    # eviction. Only the sparse representation stores rows as flat
+    # idx/val coordinate pairs, so it is the only one servable this way;
+    # checkpoint fingerprints carry the representation and
+    # personalization_from_checkpoint refuses a mismatch at load.
+    serve_personalized: bool = False
     # Offload pipeline depth (api.HostOffloadPipeline): how many rounds of
     # output rows may sit in the lazy-writeback queue while their (W, d)
     # device buffers stay alive. 2 = double buffering (gather round t+1 /
@@ -218,6 +226,12 @@ class FedConfig:
                     "client_state='sparse' cannot represent topk_down "
                     "stale-weight rows (dense by construction); drop "
                     "--topk_down or use client_state='dense'")
+        if self.serve_personalized and self.client_state != "sparse":
+            raise ValueError(
+                "--serve_personalized applies per-user O(k) idx/val "
+                "weight deltas at serving time, which only the sparse "
+                "client-state rows provide; got client_state="
+                f"{self.client_state!r} — add --client_state sparse")
         if self.client_state == "sketched":
             if self.error_type != "local":
                 raise ValueError(

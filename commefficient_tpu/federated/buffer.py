@@ -262,10 +262,12 @@ def build_buffer_programs(apply_loss: Callable, unflatten: Callable,
         n_raw = jnp.sum(jnp.where(contrib_b, buf.num_datapoints, 0.0))
         loss_mean = loss_total / jnp.maximum(n_raw, 1.0)
         if sketch_after_aggregate:
-            # aggregate-side, never vmapped: the UNBATCHED 1-D grid
-            # Pallas sketch kernel (the round-8 batched variant serves
-            # the per-worker vmapped paths, not this one)
-            agg = sketch.sketch_vec(agg, use_kernel=True)
+            # aggregate-side sketch via the batch-guard dispatch at batch
+            # 1: same 2-D grid kernel as the per-worker vmapped paths,
+            # bitwise-identical to the unbatched call — and identical to
+            # round.py's sync-path call site, which keeps the buffered
+            # lockstep trajectory pinned bit-equal to sync
+            agg = sketch.sketch_vec_batched(agg, use_kernel=True)
 
         breach = jnp.logical_or(~jnp.isfinite(loss_mean),
                                 loss_mean > cfg.nan_threshold)

@@ -98,12 +98,15 @@ def _sketched(sketched_grad, state, cfg, lr, sketch: CountSketch):
     # 'virtual' accumulates; 'none' recovers straight from the momentum table
     # (sketch+'local' is rejected by FedConfig.validate)
     err = state.Verror + v if cfg.error_type == "virtual" else v
-    # server-side, never vmapped: this estimate-all runs the UNBATCHED
-    # 1-D grid Pallas kernel (the round-8 batched variant serves the
-    # vmapped client.py/client_store.py paths, not this one)
-    vals, idxs = topk_values_indices(sketch.estimates(err, use_kernel=True),
-                                     cfg.k,
-                                     cfg.topk_approx_recall or None)
+    # server-side estimate-all, routed through the batch-guard dispatch
+    # at batch 1 so it compiles the SAME 2-D grid kernel the vmapped
+    # client.py/client_store.py paths run — one resident estimate
+    # program instead of a 1-D grid twin (bitwise-identical either way,
+    # tests/test_sketch_kernels.py)
+    vals, idxs = topk_values_indices(
+        sketch.estimates_batched(err, use_kernel=True),
+        cfg.k,
+        cfg.topk_approx_recall or None)
     update = jnp.zeros((cfg.grad_dim,)).at[idxs].set(vals)
     # the update's footprint *in sketch space*: re-sketching only the k
     # nonzeros matches sketching the dense update (up to float summation

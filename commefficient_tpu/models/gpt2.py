@@ -127,7 +127,8 @@ class CausalSelfAttention(nn.Module):
     def __call__(self, x, train: bool, cache=None, position=None):
         from commefficient_tpu.ops.attention import (
             blockwise_attention, decode_attention, full_attention,
-            kernel_prob_dropout_eligible, ring_attention)
+            kernel_prob_dropout_eligible, paged_decode_attention,
+            ring_attention)
         B, T, C = x.shape
         qkv = nn.Dense(3 * C, dtype=self.dtype,
                        kernel_init=nn.initializers.normal(0.02))(x)
@@ -155,14 +156,40 @@ class CausalSelfAttention(nn.Module):
                 raise ValueError("KV-cache decoding does not compose with "
                                  "attn_impl='ring' (no shard_map at serve "
                                  "time); serve with 'full' or 'blockwise'")
-            S = cache["k"].shape[1]
-            if T == 1:
+            if "pt" in cache:
+                # Block-paged decode (serving/paged_cache.py): the layer
+                # cache is {"k": (num_pages, page_size, H, hd) pool, "v":
+                # likewise, "pt": (B, M) int32 page table}. This token's
+                # k/v scatter into the row's frontier page (host-allocated
+                # before the step; free/done lanes point at the reserved
+                # garbage page 0, which is never attendable — the mask is
+                # by logical position). Prefill stays dense (B=1) and is
+                # packed into pages by DecodeEngine.paged_insert.
+                if T != 1:
+                    raise ValueError(
+                        "paged KV cache decodes one token per step; "
+                        "prefill runs dense and is packed host-side")
+                Pg = cache["k"].shape[1]
+                M = cache["pt"].shape[1]
+                p = jnp.minimum(position, M * Pg - 1)
+                phys = cache["pt"][jnp.arange(B), p // Pg]
+                off = p % Pg
+                ck = cache["k"].at[phys, off].set(
+                    k[:, 0].astype(cache["k"].dtype))
+                cv = cache["v"].at[phys, off].set(
+                    v[:, 0].astype(cache["v"].dtype))
+                y = paged_decode_attention(q, ck, cv, cache["pt"], p)
+                new_cache = {"k": ck, "v": cv, "pt": cache["pt"]}
+            elif T == 1:
+                S = cache["k"].shape[1]
                 p = jnp.minimum(position, S - 1)
                 hit = (jnp.arange(S)[None, :] == p[:, None])[..., None, None]
                 ck = jnp.where(hit, k.astype(cache["k"].dtype), cache["k"])
                 cv = jnp.where(hit, v.astype(cache["v"].dtype), cache["v"])
                 y = decode_attention(q, ck, cv, p)
+                new_cache = {"k": ck, "v": cv}
             else:
+                S = cache["k"].shape[1]
                 if T > S:
                     raise ValueError(
                         f"prefill length {T} exceeds cache capacity {S}")
@@ -175,7 +202,7 @@ class CausalSelfAttention(nn.Module):
                                             block_size=self.attn_block_size)
                 else:
                     y = full_attention(q, k, v, causal=True)
-            new_cache = {"k": ck, "v": cv}
+                new_cache = {"k": ck, "v": cv}
         elif self.attn_impl == "blockwise":
             if self.attn_dropout not in ("auto", "output", "kernel"):
                 raise ValueError(

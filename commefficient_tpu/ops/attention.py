@@ -126,6 +126,45 @@ def decode_attention(q, k, v, q_pos, *,
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
+def paged_decode_attention(q, k_pool, v_pool, page_table, q_pos) -> jax.Array:
+    """Single-query attention against a block-paged KV cache.
+
+    ``q`` is (B, Tq, H, D) with small static Tq (1 for serving);
+    ``k_pool``/``v_pool`` are the shared page pools, (num_pages,
+    page_size, H, D); ``page_table`` (B, M) int32 maps each row's
+    logical page m to a physical pool page (physical page 0 is the
+    reserved garbage page — free lanes and unallocated logical pages
+    point there); ``q_pos`` (B,) is each row's position of q's first
+    query. M * page_size is the logical capacity, so this scores the
+    same M*P key positions the dense ``decode_attention`` scores over
+    its (B, S, H, D) cache — the mask is by LOGICAL position
+    ``m * page_size + p <= q_pos[b] + t``, which covers garbage-page
+    reads by construction (an unallocated logical page lies entirely
+    above the row's position).
+
+    The gathered pages stay 5-D (B, M, P, H, D) end to end — they are
+    never reshaped to a (B, S, H, D) slab, so the per-step working set
+    is the gather plus (B, H, Tq, M, P) scores and the ``decode_paged``
+    audit's forbidden dense-cache shape cannot appear. f32 scores via
+    MXU accumulation (see full_attention); the (m, p) contraction runs
+    in logical order, matching the dense path's key order."""
+    B, Tq, H, D = q.shape
+    P = k_pool.shape[1]
+    M = page_table.shape[1]
+    k = k_pool[page_table]                                 # (B, M, P, H, D)
+    v = v_pool[page_table]
+    s = jnp.einsum("bqhd,bmphd->bhqmp", q, k,
+                   preferred_element_type=jnp.float32) / np.sqrt(D)
+    logical = jnp.arange(M)[:, None] * P + jnp.arange(P)[None, :]  # (M, P)
+    qp = q_pos[:, None] + jnp.arange(Tq)[None, :]          # (B, Tq)
+    mask = logical[None, None] <= qp[:, :, None, None]     # (B, Tq, M, P)
+    s = s + jnp.where(mask, 0.0, _NEG)[:, None]            # broadcast H
+    p = jax.nn.softmax(
+        s.reshape(B, H, Tq, M * P).astype(jnp.float32), axis=-1)
+    p = p.reshape(B, H, Tq, M, P).astype(q.dtype)
+    return jnp.einsum("bhqmp,bmphd->bqhd", p, v)
+
+
 def _fold_block(acc, q, kb, vb, q_pos, k_pos, kv_mask_b, causal):
     """Fold one k/v block into the online-softmax accumulator.
 

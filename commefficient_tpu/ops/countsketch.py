@@ -480,6 +480,33 @@ class CountSketch:
             per_row.append(table[row, buckets] * signs)
         return _median_small(per_row)
 
+    @partial(jax.jit, static_argnums=(0, 2))
+    def sketch_vec_batched(self, vec: jax.Array,
+                           use_kernel: bool = False) -> jax.Array:
+        """``sketch_vec`` routed through the batch-guard dispatch.
+
+        A singleton vmap over the public entry: under ``use_kernel`` on a
+        TPU backend the ``_batch_guard`` custom_vmap batching rule
+        dispatches the 2-D grid ``(batch, n_tiles)`` kernel at batch 1
+        instead of the 1-D grid kernel — the SAME program the vmapped
+        per-worker call sites (federated/client.py, client_store.py)
+        compile, so a server/aggregate-side sketch is one program, not a
+        second near-identical kernel to keep resident. Off-TPU (and for
+        over-budget shapes) the rule maps the XLA fallback, which is
+        batch-invariant. Bit-identical to ``sketch_vec`` either way
+        (tests/test_sketch_kernels.py pins both arms bitwise)."""
+        return jax.vmap(lambda v: self.sketch_vec(v, use_kernel))(
+            vec[None])[0]
+
+    @partial(jax.jit, static_argnums=(0, 2))
+    def estimates_batched(self, table: jax.Array,
+                          use_kernel: bool = False) -> jax.Array:
+        """``estimates`` routed through the batch-guard dispatch — the
+        singleton-vmap twin of ``sketch_vec_batched`` (same rationale,
+        same bitwise contract)."""
+        return jax.vmap(lambda t: self.estimates(t, use_kernel))(
+            table[None])[0]
+
     @partial(jax.jit, static_argnums=(0, 2, 3, 4))
     def unsketch(self, table: jax.Array, k: int,
                  approx_recall=None, use_kernel: bool = False) -> jax.Array:

@@ -1,14 +1,22 @@
 """Serving path: KV-cached jitted decode + continuous batching.
 
-``DecodeEngine`` owns the three compiled programs (prefill, one decode
-step for every row, and a whole-reply ``lax.scan`` generate);
-``ContinuousBatchingServer`` drives the step program over a fixed slot
-array, admitting and retiring requests between jitted steps. See
-docs/SERVING.md for the cache layout, the slot lifecycle, and the
-invariants the ``decode`` graft-audit target enforces.
+``DecodeEngine`` owns the compiled programs (prefill, one decode step
+for every row — dense-slab or block-paged — and a whole-reply
+``lax.scan`` generate); ``ContinuousBatchingServer`` drives the step
+program over a fixed slot array, admitting and retiring requests
+between jitted steps, optionally against the paged KV pools of
+``PagedKVCache`` and with per-user weight deltas from a
+``PersonalizationIndex``. See docs/SERVING.md for the cache layouts,
+the slot lifecycle, and the invariants the ``decode`` and
+``decode_paged`` graft-audit targets enforce.
 """
 
 from commefficient_tpu.serving.decode import DecodeEngine
+from commefficient_tpu.serving.paged_cache import GARBAGE_PAGE, PagedKVCache
+from commefficient_tpu.serving.personalize import (
+    PersonalizationIndex, personalization_from_checkpoint)
 from commefficient_tpu.serving.server import ContinuousBatchingServer
 
-__all__ = ["DecodeEngine", "ContinuousBatchingServer"]
+__all__ = ["DecodeEngine", "ContinuousBatchingServer", "PagedKVCache",
+           "GARBAGE_PAGE", "PersonalizationIndex",
+           "personalization_from_checkpoint"]

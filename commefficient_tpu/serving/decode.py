@@ -82,6 +82,8 @@ class DecodeEngine:
         # one compile per batch shape; sampling params are baked in
         self.prefill = jax.jit(self._prefill_raw)
         self.step = jax.jit(self._step_raw)
+        self.paged_step = jax.jit(self._paged_step_raw)
+        self.paged_insert = jax.jit(self._paged_insert_raw)
         self.generate_tokens = jax.jit(self._generate_raw,
                                        static_argnames=("max_new",))
         self.sample = jax.jit(lambda logits, rng: sample_next(
@@ -125,6 +127,60 @@ class DecodeEngine:
         nxt = jnp.where(done, jnp.int32(self.eos_id), nxt)
         new_pos = jnp.minimum(pos + 1, self.max_len - 1)
         return cache, nxt, new_pos, rng, new_done
+
+    def init_paged_pools(self, num_pages: int, page_size: int):
+        """Zero per-layer KV page pools for the block-paged server
+        (serving/paged_cache.py): a tuple with one ``{"k", "v"}`` dict
+        per layer, each (num_pages, page_size, n_head, head_dim) in the
+        compute dtype. Physical page 0 is the reserved garbage page."""
+        cfg = self.model.config
+        shape = (int(num_pages), int(page_size), cfg.n_head,
+                 cfg.n_embd // cfg.n_head)
+        return tuple({"k": jnp.zeros(shape, cfg.jnp_dtype),
+                      "v": jnp.zeros(shape, cfg.jnp_dtype)}
+                     for _ in range(cfg.n_layer))
+
+    def _paged_step_raw(self, params, pools, pt, tok, type_tok, pos, rng,
+                        done):
+        """The paged twin of ``_step_raw``: pools + page table instead of
+        the dense (B, max_len, H, hd) slab. ``pt`` (B, max_pages) int32
+        is traced — the host rebuilds it between steps (admission,
+        eviction, frontier allocation, prefix sharing) without ever
+        retracing this program. Token/done/pos semantics are identical
+        to the dense step, so greedy parity is bitwise."""
+        cache = tuple({"k": p["k"], "v": p["v"], "pt": pt} for p in pools)
+        zero = jnp.zeros_like(tok)
+        logits, cache = self._apply(params, tok[:, None], type_tok[:, None],
+                                    cache, pos, zero)
+        new_pools = tuple({"k": c["k"], "v": c["v"]} for c in cache)
+        nxt, rng = sample_next(logits, rng, method=self.method,
+                               top_k=self.top_k,
+                               temperature=self.temperature)
+        new_done = done | (nxt == self.eos_id) | (pos + 1 >= self.max_len)
+        nxt = jnp.where(done, jnp.int32(self.eos_id), nxt)
+        new_pos = jnp.minimum(pos + 1, self.max_len - 1)
+        return new_pools, nxt, new_pos, rng, new_done
+
+    def _paged_insert_raw(self, pools, row_cache, dst):
+        """Pack a B=1 dense prefilled cache row into pool pages.
+
+        ``dst`` ((prefill_len // page_size,) int32, TRACED) maps the
+        prompt's logical pages to physical pool pages; entries for
+        prefill-window pages beyond the prompt point at the garbage
+        page. One compiled program regardless of prompt length or share
+        pattern — shared pages are rewritten with bitwise-identical
+        content (causal k/v at position i depend only on tokens <= i)."""
+        n = dst.shape[0]
+        out = []
+        for pool, row in zip(pools, row_cache):
+            P = pool["k"].shape[1]
+
+            def put(pl, r):
+                pages = r[0, :n * P].reshape((n, P) + r.shape[2:])
+                return pl.at[dst].set(pages.astype(pl.dtype))
+            out.append({"k": put(pool["k"], row["k"]),
+                        "v": put(pool["v"], row["v"])})
+        return tuple(out)
 
     def _generate_raw(self, params, ids, types, lengths, reply_type, rng,
                       *, max_new):

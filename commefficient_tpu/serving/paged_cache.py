@@ -1,0 +1,188 @@
+"""Block-paged KV cache management for the continuous-batching server.
+
+The fixed-slot server reserves a dense ``(slots, max_len, H, hd)`` slab
+per layer — every admitted user pays for ``max_len`` positions of HBM up
+front, which (with the per-user weight deltas in serving/personalize.py)
+is the thing that caps concurrent personalized users per chip (ROADMAP
+item 1). Paging replaces the slab with a per-layer POOL of fixed-size
+pages plus a per-slot page table:
+
+* pools     — ``(num_pages, page_size, H, hd)`` per layer, allocated
+  once. HBM scales with pages actually in use, not slots * max_len.
+* page table — host numpy ``(slots, max_pages)`` int32 mapping each
+  slot's logical page m (positions [m*P, (m+1)*P)) to a physical pool
+  page. It crosses into the jitted step as a TRACED device array each
+  step (same shape/dtype every step — a tiny H2D copy, never a
+  retrace), so admission, eviction, page allocation and prefix sharing
+  are pure host-side bookkeeping between steps and the step stays ONE
+  compiled program for the server's lifetime.
+* physical page 0 — reserved garbage page. Free lanes and unallocated
+  logical pages point there; decode writes from done lanes land there;
+  it is never attendable because the attention mask is by LOGICAL
+  position (ops/attention.paged_decode_attention).
+* free list + refcounts — pages are recycled on eviction. Full PROMPT
+  pages are copy-on-write shared across slots whose prompts agree on
+  that page (keyed by page index + token ids + type ids — positions are
+  baked into k/v via wpe, so only position-aligned identical pages can
+  share). The frontier/partial page is always private, and decode only
+  ever writes the frontier, so a shared page is never written after
+  admission; admission re-packs shared pages with bitwise-identical
+  content (causal k/v at position i depend only on tokens <= i), which
+  keeps ONE pack program instead of a per-share-pattern variant.
+
+``PagedKVCache`` owns no device arrays: the pools live in the server
+and are written only by DecodeEngine's jitted ``paged_insert`` (prompt
+pack) and ``paged_step`` (frontier scatter) programs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+#: the reserved never-attendable physical page (see module docstring)
+GARBAGE_PAGE = 0
+
+
+class PagedKVCache:
+    """Host-side page-table/free-list/refcount bookkeeping for one
+    server. ``max_len`` and ``prefill_len`` must be multiples of
+    ``page_size`` so logical capacity is exactly ``max_pages *
+    page_size`` and the prompt pack program has a static page count."""
+
+    def __init__(self, *, slots: int, max_len: int, prefill_len: int,
+                 page_size: int = 16, num_pages: Optional[int] = None,
+                 share_prefix: bool = True):
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        if max_len % page_size:
+            raise ValueError(f"max_len {max_len} must be a multiple of "
+                             f"page_size {page_size}")
+        if prefill_len % page_size:
+            raise ValueError(f"prefill_len {prefill_len} must be a "
+                             f"multiple of page_size {page_size}")
+        self.slots = int(slots)
+        self.page_size = int(page_size)
+        self.max_pages = max_len // page_size
+        self.prefill_pages = prefill_len // page_size
+        # worst case (no sharing, every slot decoding to max_len) plus
+        # the garbage page; callers chasing the users-per-chip win size
+        # the pool smaller and rely on sharing/short replies
+        self.num_pages = int(num_pages) if num_pages \
+            else 1 + self.slots * self.max_pages
+        if self.num_pages < 2:
+            raise ValueError("need at least one non-garbage page")
+        self.share_prefix = bool(share_prefix)
+        self.table = np.zeros((self.slots, self.max_pages), np.int32)
+        self.pos = np.zeros((self.slots,), np.int64)
+        self.refcount = np.zeros((self.num_pages,), np.int64)
+        # page 0 is permanently leased to the garbage role
+        self.refcount[GARBAGE_PAGE] = 1
+        self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
+        self._page_of_key: Dict[Tuple, int] = {}
+        self._key_of_page: Dict[int, Tuple] = {}
+        self.shared_hits = 0
+
+    # ---- allocation ---------------------------------------------------
+
+    def _alloc(self) -> int:
+        if not self._free:
+            raise RuntimeError(
+                f"page pool exhausted ({self.num_pages} pages, "
+                f"{int(self.pages_in_use)} in use) — size num_pages for "
+                f"the worst-case active set or admit fewer slots")
+        phys = self._free.pop()
+        self.refcount[phys] = 1
+        return phys
+
+    def _unref(self, phys: int) -> None:
+        self.refcount[phys] -= 1
+        if self.refcount[phys] == 0:
+            key = self._key_of_page.pop(phys, None)
+            if key is not None:
+                del self._page_of_key[key]
+            self._free.append(phys)
+
+    @property
+    def pages_in_use(self) -> int:
+        return self.num_pages - 1 - len(self._free)
+
+    # ---- request lifecycle (host-side, between jitted steps) ----------
+
+    def admit(self, slot: int, ids: Sequence[int], types: Sequence[int],
+              *, shareable: bool = True) -> np.ndarray:
+        """Allocate pages covering the prompt [0, len(ids)) for ``slot``
+        and return the pack destination vector ``dst``
+        ((prefill_pages,) int32): entry j is the physical page for
+        logical page j, or GARBAGE_PAGE for prefill-window pages beyond
+        the prompt (their pad-derived content must land somewhere, and
+        the garbage page absorbs it without a variable-shape pack).
+
+        Full prompt pages are shared by (page index, ids, types) when
+        sharing is on; the frontier/partial page is always private."""
+        L = len(ids)
+        if L > self.prefill_pages * self.page_size:
+            raise ValueError(f"prompt length {L} exceeds the prefill "
+                             f"window {self.prefill_pages * self.page_size}")
+        row = self.table[slot]
+        if row.any():
+            raise RuntimeError(f"slot {slot} admitted without release")
+        P = self.page_size
+        n_cover = -(-L // P)
+        for j in range(n_cover):
+            full = (j + 1) * P <= L
+            if full and shareable and self.share_prefix:
+                key = (j, tuple(int(t) for t in ids[j * P:(j + 1) * P]),
+                       tuple(int(t) for t in types[j * P:(j + 1) * P]))
+                phys = self._page_of_key.get(key)
+                if phys is not None:
+                    self.refcount[phys] += 1
+                    self.shared_hits += 1
+                else:
+                    phys = self._alloc()
+                    self._page_of_key[key] = phys
+                    self._key_of_page[phys] = key
+                row[j] = phys
+            else:
+                row[j] = self._alloc()
+        self.pos[slot] = L
+        dst = np.full((self.prefill_pages,), GARBAGE_PAGE, np.int32)
+        dst[:n_cover] = row[:n_cover]
+        return dst
+
+    def ensure_frontier(self, slot: int) -> None:
+        """Guarantee the page holding ``slot``'s next write position is
+        allocated (private) — called for every active slot before each
+        step. A no-op except when the position just crossed a page
+        boundary (including a page-aligned prompt's first decode)."""
+        m = int(self.pos[slot]) // self.page_size
+        if m < self.max_pages and self.table[slot, m] == GARBAGE_PAGE:
+            self.table[slot, m] = self._alloc()
+
+    def advance(self, slot: int) -> None:
+        """Mirror the device-side position latch after a step."""
+        self.pos[slot] = min(self.pos[slot] + 1,
+                             self.max_pages * self.page_size - 1)
+
+    def release(self, slot: int) -> None:
+        """Return ``slot``'s pages (decref — shared pages free only when
+        the last sharer leaves) and point the row back at garbage."""
+        row = self.table[slot]
+        for phys in row[row != GARBAGE_PAGE]:
+            self._unref(int(phys))
+        row[:] = GARBAGE_PAGE
+        self.pos[slot] = 0
+
+    def device_table(self):
+        """The page table as the step program's traced (slots,
+        max_pages) int32 argument — same shape/dtype every step.
+
+        ``jnp.array`` (copy semantics), NOT ``jnp.asarray``: on the CPU
+        backend asarray can alias the numpy buffer zero-copy, and the
+        host mutates ``self.table`` (admission, release, frontier
+        allocation) while the asynchronously dispatched step may still
+        be reading it — a data race that shows up as rare wrong-page
+        attends. The copy is slots * max_pages int32, negligible."""
+        return jnp.array(self.table)

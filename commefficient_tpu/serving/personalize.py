@@ -1,0 +1,224 @@
+"""Per-user weight personalization for the continuous-batching server.
+
+The federated client state store (federated/client_store.py, under
+``--client_state sparse``) already holds an O(k) encoded row per client:
+``cap`` largest-|value| coordinates of that client's residual/velocity
+in the flat gradient space.  ``PersonalizationIndex`` turns that store
+into a SERVING index: at slot admission the user's row is applied to the
+shared served params as a sparse weight delta (``base + scale * row``),
+and at retirement it is subtracted again — base params stay shared, and
+the per-user cost is O(cap) host work plus the touched param leaves on
+device.  A million-user store therefore serves directly: nothing is
+densified, no per-user parameter copy ever exists.
+
+Exactness contract (tests/test_paged_serving.py):
+
+* a user whose stored row is all-zero touches NOTHING — zero-valued
+  entries are marked dead host-side and every device scatter they could
+  reach is dropped, so the params object is returned unchanged
+  (trivially bitwise-identical to base, and immune to the
+  ``-0.0 + 0.0 == +0.0`` float hazard);
+* with a single active user, admission is exactly
+  ``flat(base).at[idx].add(scale * val)`` and eviction restores base
+  BITWISE: the restore scatters ``base`` values back (gated ``where``
+  against the correction term) rather than subtracting the delta, so
+  float rounding cannot accumulate across admit/evict cycles;
+* with several active users the served params are
+  ``base + sum of active deltas`` — coordinates touched by more than
+  one user compose additively.  That is the documented O(k)
+  approximation: rows are "independent" per slot only in the KV cache,
+  the weights are genuinely shared.
+
+Flat index space: the store's coordinates index the raveled gradient
+(utils/params.flatten_params, i.e. ``ravel_pytree`` order), which is
+``jax.tree.leaves`` order with each leaf raveled C-order — the leaf
+offset table below reproduces it.  Coordinates past the last leaf (the
+``round_up`` padding of ``grad_dim``) fall in no leaf and are dropped.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class PersonalizationIndex:
+    """Refcounted apply/evict of per-user sparse weight deltas.
+
+    ``store`` must be a HostArenaStore with the sparse codec; ``field``
+    picks which per-client row serves as the delta (default ``errors``,
+    the FetchSGD residual — the coordinates the server's top-k keeps
+    dropping for this client are exactly where its local data disagrees
+    with the global model).  ``scale`` rescales the stored values at
+    admission.
+    """
+
+    def __init__(self, base_params, store, *, field: str = "errors",
+                 scale: float = 1.0):
+        codec_name = getattr(getattr(store, "codec", None), "name", None)
+        if codec_name != "sparse":
+            raise ValueError(
+                f"personalized serving needs the sparse client-state "
+                f"representation (O(k) idx/val rows); store codec is "
+                f"{codec_name!r} — run with --client_state sparse")
+        if store._arenas.get(field) is None:
+            raise ValueError(f"client store has no {field!r} arena")
+        self.store = store
+        self.field = field
+        self.scale = float(scale)
+        self.base = base_params
+        leaves, self._treedef = jax.tree_util.tree_flatten(base_params)
+        self._base_leaves = leaves
+        sizes = [int(np.prod(l.shape)) for l in leaves]
+        self._offsets = np.concatenate([[0], np.cumsum(sizes)])[:-1]
+        self._sizes = sizes
+        #: user_id -> {"idx", "val" (scaled), "dead", "count"}
+        self.active: Dict[int, dict] = {}
+        # one jitted program per distinct leaf shape (bounded by the
+        # model's leaf-shape count), slot-surgery style: indices are
+        # traced, so the same user admitted twice reuses the compile
+        self._leaf_add = jax.jit(self._leaf_add_raw)
+        self._leaf_restore = jax.jit(self._leaf_restore_raw)
+
+    # ---- jitted per-leaf scatters ------------------------------------
+
+    @staticmethod
+    def _leaf_add_raw(leaf, lidx, lval):
+        flat = leaf.reshape(-1)
+        return flat.at[lidx].add(lval.astype(flat.dtype),
+                                 mode="drop").reshape(leaf.shape)
+
+    @staticmethod
+    def _leaf_restore_raw(leaf, base_leaf, lidx, lcorr):
+        # scatter BASE values back (plus any still-active users'
+        # contributions at shared coordinates); the where-gate keeps the
+        # corr == 0 lanes bitwise-equal to base instead of base + 0.0
+        flat = leaf.reshape(-1)
+        b = base_leaf.reshape(-1).astype(flat.dtype)
+        safe = jnp.minimum(lidx, flat.shape[0] - 1)   # sentinel-clamped
+        base_vals = b[safe]
+        lcorr = lcorr.astype(flat.dtype)
+        new = jnp.where(lcorr != 0, base_vals + lcorr, base_vals)
+        return flat.at[lidx].set(new, mode="drop").reshape(leaf.shape)
+
+    # ---- host-side row handling --------------------------------------
+
+    def _fetch(self, user_id: int) -> dict:
+        row = self.store.row(self.field, int(user_id))
+        idx = np.asarray(row["idx"], np.int64)
+        val = np.asarray(row["val"], np.float32)
+        if self.scale != 1.0:
+            val = (np.float32(self.scale) * val).astype(np.float32)
+        # zero-valued entries (including the store's all-zero init rows,
+        # whose duplicate index-0 padding would otherwise double-apply)
+        # are dead: they reach no device scatter at all
+        return {"idx": idx, "val": val, "dead": val == 0.0, "count": 1}
+
+    def _corr_at(self, idx: np.ndarray) -> np.ndarray:
+        """Sum of the remaining active users' values at coordinates
+        ``idx`` — what eviction must leave behind on shared entries."""
+        corr = np.zeros(idx.shape, np.float32)
+        for other in self.active.values():
+            oidx, oval = other["idx"], np.where(other["dead"], np.float32(0),
+                                                other["val"])
+            order = np.argsort(oidx, kind="stable")
+            so, sv = oidx[order], oval[order]
+            pos = np.searchsorted(so, idx)
+            safe = np.minimum(pos, so.shape[0] - 1)
+            hit = (pos < so.shape[0]) & (so[safe] == idx)
+            # live entries have distinct coordinates per user (top-k);
+            # duplicate DEAD coordinates carry value 0 either way
+            corr += np.where(hit, sv[safe], np.float32(0))
+        return corr
+
+    # ---- server hooks -------------------------------------------------
+
+    def admit(self, params, user_id: int):
+        """Apply ``user_id``'s delta to ``params`` (refcounted: a user
+        already active in another slot is applied once and counted)."""
+        ent = self.active.get(int(user_id))
+        if ent is not None:
+            ent["count"] += 1
+            return params
+        ent = self._fetch(user_id)
+        self.active[int(user_id)] = ent
+        idx, val, dead = ent["idx"], ent["val"], ent["dead"]
+        if dead.all():                     # zero delta: touch nothing
+            return params
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        assert treedef == self._treedef
+        out = []
+        for leaf, off, size in zip(leaves, self._offsets, self._sizes):
+            sel = (idx >= off) & (idx < off + size) & ~dead
+            if not sel.any():              # untouched leaf: skip on host
+                out.append(leaf)
+                continue
+            lidx = np.where(sel, idx - off, size).astype(np.int32)
+            lval = np.where(sel, val, np.float32(0))
+            out.append(self._leaf_add(leaf, jnp.asarray(lidx),
+                                      jnp.asarray(lval)))
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def evict(self, params, user_id: int):
+        """Remove ``user_id``'s delta (when its last slot retires),
+        restoring its touched coordinates to base plus whatever the
+        still-active users contribute there."""
+        ent = self.active.get(int(user_id))
+        if ent is None:
+            raise KeyError(f"user {user_id} is not active")
+        ent["count"] -= 1
+        if ent["count"] > 0:
+            return params
+        del self.active[int(user_id)]
+        idx, dead = ent["idx"], ent["dead"]
+        if dead.all():                     # zero delta never applied
+            return params
+        corr = self._corr_at(idx)
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        assert treedef == self._treedef
+        out = []
+        for leaf, base_leaf, off, size in zip(
+                leaves, self._base_leaves, self._offsets, self._sizes):
+            sel = (idx >= off) & (idx < off + size) & ~dead
+            if not sel.any():
+                out.append(leaf)
+                continue
+            lidx = np.where(sel, idx - off, size).astype(np.int32)
+            lcorr = np.where(sel, corr, np.float32(0))
+            out.append(self._leaf_restore(leaf, base_leaf,
+                                          jnp.asarray(lidx),
+                                          jnp.asarray(lcorr)))
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def personalization_from_checkpoint(fingerprint: Optional[dict], store,
+                                    base_params, *, field: str = "errors",
+                                    scale: float = 1.0):
+    """Gate a PersonalizationIndex on a checkpoint's config fingerprint.
+
+    * fingerprint is None or predates the ``client_state`` key (legacy
+      checkpoint): warn and return None — the server keeps serving
+      UNPERSONALIZED rather than misreading rows under the wrong codec;
+    * fingerprint records a non-sparse representation: refuse loudly —
+      sketched/dense rows are not O(k) coordinate deltas and silently
+      decoding them as such would corrupt every served user;
+    * fingerprint says ``sparse``: build the index.
+    """
+    if fingerprint is None or "client_state" not in fingerprint:
+        warnings.warn(
+            "checkpoint fingerprint has no client_state record (legacy "
+            "checkpoint, or dense state) — serving unpersonalized",
+            stacklevel=2)
+        return None
+    rep = fingerprint["client_state"]
+    if rep != "sparse":
+        raise ValueError(
+            f"--serve_personalized needs --client_state sparse rows, but "
+            f"the checkpoint was trained with client_state={rep!r}; "
+            f"re-train or re-encode the store before serving deltas")
+    return PersonalizationIndex(base_params, store, field=field,
+                                scale=scale)

@@ -25,6 +25,18 @@ Per-row independence of the decode step (each row attends only its own
 cache rows) makes the served reply for a request identical to what
 ``DecodeEngine.generate`` would produce for it alone — asserted in
 tests/test_decode.py.
+
+``kv_cache="paged"`` swaps the dense per-slot cache slab for the
+block-paged pools of serving/paged_cache.py: admission packs the B=1
+prefilled row into pool pages (one jitted pack program), the step runs
+``engine.paged_step`` against the pools + traced page table, and
+retirement returns pages to the free list — same one-program-per-
+lifetime invariant, greedy-bitwise-identical tokens (the ``decode_paged``
+audit target and tests/test_paged_serving.py hold both). Passing
+``personalize=`` (a serving.personalize.PersonalizationIndex) applies a
+per-user sparse weight delta at admission and subtracts it at
+retirement, so requests carrying ``user_id`` decode under base + that
+user's delta while base params stay shared.
 """
 
 from __future__ import annotations
@@ -45,20 +57,42 @@ class _Request:
     types: Sequence[int]
     reply_type: int
     max_new: int
+    user_id: object = None
     out: List[int] = field(default_factory=list)
 
 
 class ContinuousBatchingServer:
     def __init__(self, engine, *, slots: int = 8, prefill_len: int = 64,
-                 seed: int = 0):
+                 seed: int = 0, kv_cache: str = "fixed",
+                 page_size: int = 16, num_pages: int = None,
+                 share_prefix: bool = True, personalize=None):
         if prefill_len > engine.max_len:
             raise ValueError(f"prefill_len {prefill_len} exceeds cache "
                              f"capacity {engine.max_len}")
+        if kv_cache not in ("fixed", "paged"):
+            raise ValueError(f"kv_cache must be 'fixed' or 'paged', "
+                             f"got {kv_cache!r}")
         self.engine = engine
         self.slots = int(slots)
         self.prefill_len = int(prefill_len)
+        self.kv_cache = kv_cache
+        self.personalize = personalize
         B = self.slots
-        self.cache = engine.init_cache(B)
+        if kv_cache == "paged":
+            from commefficient_tpu.serving.paged_cache import PagedKVCache
+
+            # per-user weight deltas make page content user-dependent, so
+            # cross-user prefix sharing is off whenever a personalization
+            # index is attached (docs/SERVING.md "sharing semantics")
+            self.pager = PagedKVCache(
+                slots=B, max_len=engine.max_len, prefill_len=prefill_len,
+                page_size=page_size, num_pages=num_pages,
+                share_prefix=share_prefix and personalize is None)
+            self.cache = engine.init_paged_pools(self.pager.num_pages,
+                                                 page_size)
+        else:
+            self.pager = None
+            self.cache = engine.init_cache(B)
         self.tok = jnp.full((B,), engine.pad_id, jnp.int32)
         self.typ = jnp.zeros((B,), jnp.int32)
         self.pos = jnp.zeros((B,), jnp.int32)
@@ -95,15 +129,36 @@ class ContinuousBatchingServer:
     # ---- request lifecycle -------------------------------------------
 
     def submit(self, ids: Sequence[int], types: Sequence[int],
-               reply_type: int, max_new: int) -> int:
+               reply_type: int, max_new: int, user_id=None) -> int:
         if len(ids) > self.prefill_len:
             raise ValueError(f"prompt length {len(ids)} exceeds "
                              f"prefill_len {self.prefill_len}")
+        if user_id is not None and self.personalize is None:
+            raise ValueError("submit got a user_id but the server has no "
+                             "personalization index attached")
         rid = self._next_rid
         self._next_rid += 1
         self._queue.append(_Request(rid, list(ids), list(types),
-                                    int(reply_type), int(max_new)))
+                                    int(reply_type), int(max_new),
+                                    user_id))
         return rid
+
+    def _params_for(self, req: _Request):
+        """Admission-time served params: base, or base + the user's
+        sparse delta applied in place on device (O(k) per admission).
+        The delta stays applied until _retire evicts it, so the shared
+        decode step serves every active user's personalized weights at
+        once — rows are independent only because each user's touched
+        coordinates compose additively (serving/personalize.py)."""
+        if self.personalize is not None and req.user_id is not None:
+            self.engine.params = self.personalize.admit(
+                self.engine.params, req.user_id)
+        return self.engine.params
+
+    def _evict_user(self, req: _Request) -> None:
+        if self.personalize is not None and req.user_id is not None:
+            self.engine.params = self.personalize.evict(
+                self.engine.params, req.user_id)
 
     def _admit(self) -> List[Tuple[int, List[int]]]:
         eng = self.engine
@@ -116,22 +171,31 @@ class ContinuousBatchingServer:
             typ = np.full((1, P), eng.pad_id, np.int32)
             ids[0, :L] = req.ids
             typ[0, :L] = req.types
+            params = self._params_for(req)
             logits, row_cache = eng.prefill(
-                eng.params, eng.init_cache(1), jnp.asarray(ids),
+                params, eng.init_cache(1), jnp.asarray(ids),
                 jnp.asarray(typ), jnp.asarray([L - 1], jnp.int32))
             first, self.rng = eng.sample(logits, self.rng)
             t = int(np.asarray(first)[0])       # admission-time sync
             if t == eng.eos_id or req.max_new <= 0:
                 finished.append((req.rid, []))
                 self._free.append(slot)
+                self._evict_user(req)
                 continue
             req.out.append(t)
             if req.max_new == 1 or L >= eng.max_len:
                 finished.append((req.rid, list(req.out)))
                 self._free.append(slot)
+                self._evict_user(req)
                 continue
-            self.cache = self._insert(self.cache, row_cache,
-                                      jnp.int32(slot))
+            if self.pager is not None:
+                dst = self.pager.admit(slot, req.ids, req.types,
+                                       shareable=req.user_id is None)
+                self.cache = eng.paged_insert(self.cache, row_cache,
+                                              jnp.asarray(dst))
+            else:
+                self.cache = self._insert(self.cache, row_cache,
+                                          jnp.int32(slot))
             self.tok, self.typ, self.pos, self.done = self._set_row(
                 self.tok, self.typ, self.pos, self.done, jnp.int32(slot),
                 jnp.int32(t), jnp.int32(req.reply_type), jnp.int32(L))
@@ -144,21 +208,35 @@ class ContinuousBatchingServer:
         self._slot_req[slot] = None
         self._free.append(slot)
         self.done = self._release(self.done, jnp.int32(slot))
+        if self.pager is not None:
+            self.pager.release(slot)
+        self._evict_user(req)
 
     def step(self) -> List[Tuple[int, List[int]]]:
         """Admit, advance every slot one token, retire. Returns the
         requests finished this step as (rid, reply_tokens)."""
         finished = self._admit()
-        if all(r is None for r in self._slot_req):
+        active = [s for s, r in enumerate(self._slot_req) if r is not None]
+        if not active:
             return finished
-        (self.cache, self.tok, self.pos, self.rng,
-         self.done) = self.engine.step(self.engine.params, self.cache,
-                                       self.tok, self.typ, self.pos,
-                                       self.rng, self.done)
+        if self.pager is not None:
+            for slot in active:
+                self.pager.ensure_frontier(slot)
+            pt = self.pager.device_table()
+            (self.cache, self.tok, self.pos, self.rng,
+             self.done) = self.engine.paged_step(
+                self.engine.params, self.cache, pt, self.tok, self.typ,
+                self.pos, self.rng, self.done)
+            for slot in active:
+                self.pager.advance(slot)
+        else:
+            (self.cache, self.tok, self.pos, self.rng,
+             self.done) = self.engine.step(self.engine.params, self.cache,
+                                           self.tok, self.typ, self.pos,
+                                           self.rng, self.done)
         toks = np.asarray(self.tok)             # ONE host pull per step
-        for slot, req in enumerate(self._slot_req):
-            if req is None:
-                continue
+        for slot in active:
+            req = self._slot_req[slot]
             t = int(toks[slot])
             if t == self.engine.eos_id:
                 self._retire(slot, finished)

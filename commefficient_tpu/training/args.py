@@ -137,6 +137,16 @@ def build_parser(default_lr: float = 0.4) -> argparse.ArgumentParser:
                    help="CountSketch rows r for --client_state sketched")
     p.add_argument("--client_sketch_cols", type=int, default=128,
                    help="CountSketch cols c for --client_state sketched")
+    p.add_argument("--serve_personalized", action="store_true",
+                   help="serve per-user weight deltas from the client "
+                        "state store (serving/personalize.py): each "
+                        "admitted request's O(k) idx/val row is applied "
+                        "to the served params at admission and removed "
+                        "at eviction. Requires --client_state sparse "
+                        "(the only representation storing flat "
+                        "coordinate rows); checkpoint fingerprints "
+                        "record the representation and loading refuses "
+                        "a mismatch")
     p.add_argument("--offload_pipeline_depth", type=int, default=2,
                    help="rounds of offloaded output rows that may queue "
                         "for lazy host writeback (api.HostOffloadPipeline)"

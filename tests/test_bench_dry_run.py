@@ -34,7 +34,7 @@ def test_registry_covers_every_row():
     a row cannot exist in one mode and be silently skipped by the
     other."""
     names = [n for n, _ in bench._bench_rows()]
-    assert len(names) == len(set(names)) == 23
+    assert len(names) == len(set(names)) == 25
     for must in ("cifar10_resnet9_fed_rounds_per_sec",
                  "cifar10_resnet9_per_worker_sketch_ab",
                  "gpt2_fetchsgd_per_worker_sketch_ab",
@@ -52,7 +52,9 @@ def test_registry_covers_every_row():
                  "buffered_fedbuff_round_overhead",
                  "gpt2_decode_tokens_per_sec_chip_b1",
                  "gpt2_decode_tokens_per_sec_chip_b8",
-                 "gpt2_decode_tokens_per_sec_chip_b64"):
+                 "gpt2_decode_tokens_per_sec_chip_b64",
+                 "gpt2_decode_paged_tokens_per_sec_ab",
+                 "serve_personalized_admission_overhead"):
         assert must in names
 
 
@@ -115,6 +117,25 @@ def test_decode_row_traces_prefill_generate_and_ab(dry):
     status, breakdown = bench.bench_generate(batch=1, ab_uncached=True)
     assert status["dry_run"] == "ok"
     assert breakdown == {}
+
+
+def test_paged_decode_row_traces_pack_and_step(dry):
+    """The paged serving A/B row: the pool pack (paged_insert) and the
+    page-table-traced paged step both trace via eval_shape — kv-pool or
+    page-table signature drift fails here on CPU."""
+    status, breakdown = bench.bench_decode_paged_ab()
+    assert status["dry_run"] == "ok"
+    assert status["out_leaves"] > 0
+    assert breakdown == {}
+
+
+def test_personalized_admission_row_runs_exactness_contract(dry):
+    """The --serve_personalized row's dry run exercises the REAL
+    admit/evict contract at tiny scale: zero-delta object identity and
+    bitwise restore are asserted inside the row."""
+    out = bench.bench_personalized_admission()
+    assert out["dry_run"] == "ok"
+    assert out["d"] > 0
 
 
 def test_per_worker_sketch_ab_row_traces_both_arms(dry):

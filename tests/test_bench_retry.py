@@ -154,6 +154,31 @@ def test_main_emits_json_and_exits_zero_despite_failed_metrics(
         bench, "bench_client_store_sketched_codec",
         lambda: (1.05, {"global_total_ms": 10.0, "tiled_total_ms": 9.5}))
 
+    monkeypatch.setattr(
+        bench, "bench_client_store_gather_scatter",
+        lambda **kw: {"gather_ms_1m": 5.0, "scatter_ms_1m": 4.0,
+                      "arena_bytes_1m": 512 << 20,
+                      "gather_ms_10k": 4.0, "scatter_ms_10k": 3.5})
+    monkeypatch.setattr(
+        bench, "bench_buffered_rounds",
+        lambda **kw: {"round_sync_ms": 50.0,
+                      "round_buffered_lockstep_ms": 52.0,
+                      "cohort_buffered_faulted_ms": 60.0,
+                      "event_loop_overhead_ms": 8.0,
+                      "faulted_sim_time": 12.0,
+                      "faulted_applies_per_cohort": 0.9})
+    monkeypatch.setattr(
+        bench, "bench_decode_paged_ab",
+        lambda **kw: (1.02, {"paged_tokens_per_sec_b64": 50_000.0,
+                             "fixed_tokens_per_sec_b64": 49_000.0,
+                             "users_per_chip_at_fixed_hbm_x_b64": 2.1}))
+    monkeypatch.setattr(
+        bench, "bench_personalized_admission",
+        lambda **kw: {"admission_delta_apply_ms": 1.5,
+                      "eviction_restore_ms": 1.7, "prefill_ms": 30.0,
+                      "overhead_vs_prefill_pct": 5.0,
+                      "k": 256, "d": 124_000_000, "n_users": 16})
+
     def dead(*a, **k):
         raise RuntimeError("UNAVAILABLE: tunnel read body")
 
@@ -178,6 +203,8 @@ def test_main_emits_json_and_exits_zero_despite_failed_metrics(
     assert "cifar10_resnet9_per_worker_sketch_ab" in metrics
     assert "gpt2_fetchsgd_per_worker_sketch_ab" in metrics
     assert "client_store_sketched_codec" in metrics
+    assert "gpt2_decode_paged_tokens_per_sec_ab" in metrics
+    assert "serve_personalized_admission_overhead" in metrics
     # the dead metrics are absent from the numbers but present in errors
     assert "gpt2_fetchsgd_sketch_rounds_per_sec" not in metrics
     failed = {e["metric"] for e in out["errors"]}

@@ -227,3 +227,53 @@ def test_force_dispatch_routes_public_api_to_kernel_on_cpu():
         fn = jax.vmap(lambda v: cs.sketch_vec(v, use_kernel=True))
         assert not _jaxpr_has_pallas(fn, vecs)
         np.testing.assert_array_equal(np.asarray(fn(vecs)), np.asarray(ref))
+
+
+def test_batched_entry_points_bitwise_on_cpu_xla():
+    """The aggregate/server-side call sites (federated/server.py,
+    buffer.py, round.py) now go through sketch_vec_batched /
+    estimates_batched — a singleton vmap over the batch-guarded entry.
+    On the CPU tier-1 the backend gate maps the XLA fallback at batch 1,
+    which must be bitwise-equal to the unbatched call (lockstep buffered
+    == sync hangs on this)."""
+    d = 1_500
+    cs = CountSketch(d=d, c=256, r=3, seed=2, scheme="tiled")
+    rng = np.random.RandomState(12)
+    vec = jax.numpy.asarray(rng.randn(d).astype(np.float32))
+    table = cs.sketch_vec(vec)
+    np.testing.assert_array_equal(
+        np.asarray(cs.sketch_vec_batched(vec, use_kernel=True)),
+        np.asarray(cs.sketch_vec(vec, use_kernel=True)))
+    np.testing.assert_array_equal(
+        np.asarray(cs.estimates_batched(table, use_kernel=True)),
+        np.asarray(cs.estimates(table, use_kernel=True)))
+
+
+def test_batched_entry_points_dispatch_batched_kernel_bitwise():
+    """Under force_dispatch('kernel') the singleton-vmap entries must
+    dispatch a pallas kernel (the 2-D grid batched variant, at batch 1)
+    and stay bitwise-equal to both the unbatched kernel and the XLA
+    reference — the contract that let the server/aggregate call sites
+    drop their 1-D grid twin."""
+    from commefficient_tpu.ops.sketch_kernels import force_dispatch
+    d = 1_500
+    cs = CountSketch(d=d, c=256, r=3, seed=2, scheme="tiled")
+    rng = np.random.RandomState(13)
+    vec = jax.numpy.asarray(rng.randn(d).astype(np.float32))
+    ref_table = np.asarray(cs.sketch_vec(vec, use_kernel=False))
+    ref_est = np.asarray(cs.estimates(jax.numpy.asarray(ref_table),
+                                      use_kernel=False))
+    with force_dispatch("kernel"):
+        assert _jaxpr_has_pallas(
+            lambda v: cs.sketch_vec_batched(v, use_kernel=True), vec)
+        bat = np.asarray(cs.sketch_vec_batched(vec, use_kernel=True))
+        unb = np.asarray(cs.sketch_vec(vec, use_kernel=True))
+        np.testing.assert_array_equal(bat, unb)
+        np.testing.assert_array_equal(bat, ref_table)
+        t = jax.numpy.asarray(ref_table)
+        assert _jaxpr_has_pallas(
+            lambda x: cs.estimates_batched(x, use_kernel=True), t)
+        ebat = np.asarray(cs.estimates_batched(t, use_kernel=True))
+        eunb = np.asarray(cs.estimates(t, use_kernel=True))
+        np.testing.assert_array_equal(ebat, eunb)
+        np.testing.assert_array_equal(ebat, ref_est)
