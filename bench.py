@@ -1352,6 +1352,280 @@ def bench_personalized_admission(n_users=16, k=256, prompt_len=128):
     }
 
 
+def bench_decode_speculative_ab(gammas=(0, 2, 4, 8), batches=(1, 8),
+                                prompt_len=128, new_tokens=64,
+                                page_size=16):
+    """Speculative decoding A/B over the paged serving stack: the
+    continuous-batching server run over the same greedy request stream
+    with ``speculate_k`` swept over γ ∈ ``gammas`` (γ=0 is the
+    non-speculative incumbent) at each batch size. The drafter is a
+    randomly-initialized ``GPT2Config.tiny()``-class model sharing the
+    target's vocab, which prices the MECHANISM honestly: a random
+    drafter's acceptance is near-floor, so a loss at every γ is the
+    budgeted, publishable answer for an untrained drafter, and the
+    acceptance-rate breakdown says how much a distilled drafter would
+    have to accept for the γ-round arithmetic (γ drafter forwards + one
+    γ+1-token target forward per up-to-γ+1 tokens) to win. A
+    self-drafting ceiling arm (drafter == target, acceptance 1.0) bounds
+    the mechanism's best case at the largest batch. Emitted tokens are
+    bitwise the non-speculative stream by construction
+    (tests/test_speculative.py asserts it; this row only times).
+
+    Dry-run traces the draft and paged-verify programs via eval_shape —
+    the verify stays paged end to end (the decode_speculative audit pins
+    the no-dense-slab invariant).
+
+    Returns (best speculative tokens/s over the γ=0 arm at the largest
+    batch, breakdown with per-γ tokens/s + acceptance rates)."""
+    import jax
+    import jax.numpy as jnp
+
+    from commefficient_tpu.models.gpt2 import GPT2Config, GPT2DoubleHeads
+    from commefficient_tpu.serving import (ContinuousBatchingServer,
+                                           DecodeEngine)
+
+    P, N = prompt_len, new_tokens
+    S = P + N
+    V = 50262
+    gcfg = GPT2Config.small(vocab_size=V)
+    gcfg.n_positions = max(gcfg.n_positions, S)
+    gcfg.dropout = 0.0
+    gcfg.dtype = "bfloat16"
+    model = GPT2DoubleHeads(gcfg)
+    dcfg = GPT2Config.tiny(vocab_size=V)
+    dcfg.n_positions = max(dcfg.n_positions, S)
+    dcfg.dtype = "bfloat16"
+    drafter = GPT2DoubleHeads(dcfg)
+    rng = np.random.RandomState(0)
+    key = jax.random.PRNGKey(0)
+    sample_in = (jnp.zeros((1, 1, 8), jnp.int32),
+                 jnp.zeros((1, 1, 8), jnp.int32),
+                 jnp.zeros((1, 1), jnp.int32))
+
+    if DRY_RUN:
+        from commefficient_tpu.serving.paged_cache import PagedKVCache
+        from commefficient_tpu.serving.speculative import SpeculativeDecoder
+
+        B, gamma = batches[0], gammas[-1] or 4
+        params = jax.eval_shape(
+            lambda r: model.init(r, *sample_in, train=False), key)["params"]
+        dparams = jax.eval_shape(
+            lambda r: drafter.init(r, *sample_in, train=False),
+            key)["params"]
+        engine = DecodeEngine(model, params, eos_id=V - 1, max_len=S,
+                              method="greedy")
+        spec = SpeculativeDecoder(engine, gamma=gamma, slots=B,
+                                  drafter_model=drafter,
+                                  drafter_params=dparams)
+        pager = PagedKVCache(slots=B, max_len=S, prefill_len=P,
+                             page_size=page_size)
+        pools = jax.eval_shape(
+            lambda: engine.init_paged_pools(pager.num_pages, page_size))
+        vec = jax.ShapeDtypeStruct((B,), jnp.int32)
+        done = jax.ShapeDtypeStruct((B,), jnp.bool_)
+        _, drafts = jax.eval_shape(spec._draft_raw, dparams, spec.dcache,
+                                   vec, vec, vec, vec, vec)
+        assert drafts.shape == (B, gamma), drafts.shape
+        out = jax.eval_shape(
+            spec._paged_verify_raw, params, pools,
+            jax.ShapeDtypeStruct((B, pager.max_pages), jnp.int32),
+            vec, vec, vec, drafts, done)
+        assert out[1].shape == (B, gamma + 1), out[1].shape  # emitted
+        return {"dry_run": "ok",
+                "out_leaves": len(jax.tree.leaves(out))}, {}
+
+    params = model.init(key, *sample_in, train=False)["params"]
+    dparams = drafter.init(jax.random.PRNGKey(1), *sample_in,
+                           train=False)["params"]
+    engine = DecodeEngine(model, params, eos_id=V - 1, max_len=S,
+                          method="greedy")
+    breakdown = {"prompt_len": P, "new_tokens": N, "page_size": page_size,
+                 "drafter": "tiny-random",
+                 "gammas": list(gammas), "batches": list(batches)}
+    ratio = None
+    for B in batches:
+        reqs = []
+        for _ in range(2 * B):
+            L = int(rng.randint(P // 2, P + 1))
+            reqs.append((rng.randint(0, 50000, L).astype(np.int32).tolist(),
+                         [1] * L))
+
+        def run_arm(g, dm=None, dp=None, tag=""):
+            kw = {}
+            if g:
+                kw = {"speculate_k": g, "drafter_model": dm or drafter,
+                      "drafter_params": dp if dp is not None else dparams}
+            warm = ContinuousBatchingServer(engine, slots=B, prefill_len=P,
+                                            kv_cache="paged",
+                                            page_size=page_size, **kw)
+            warm.submit(reqs[0][0], reqs[0][1], 1, 2)
+            warm.run()
+            srv = ContinuousBatchingServer(engine, slots=B, prefill_len=P,
+                                           kv_cache="paged",
+                                           page_size=page_size, **kw)
+            for ids, types in reqs:
+                srv.submit(ids, types, 1, N)
+            got = 0
+            t0 = time.perf_counter()
+            while srv._queue or any(r is not None for r in srv._slot_req):
+                for _, toks in srv.step():
+                    got += len(toks)
+            dt = time.perf_counter() - t0
+            breakdown[f"spec{tag}_g{g}_b{B}_tokens_per_sec"] = round(
+                got / dt, 1)
+            if g:
+                st = srv.stats()
+                breakdown[f"acceptance_rate{tag}_g{g}_b{B}"] = round(
+                    st["acceptance_rate"] or 0.0, 4)
+            return got / dt
+
+        base = run_arm(0)
+        best = max(run_arm(g) for g in gammas if g)
+        ratio = best / base
+        if B == max(batches):
+            # self-drafting ceiling: acceptance 1.0 by construction, so
+            # this is the best any drafter of the TARGET's cost could do
+            run_arm(max(g for g in gammas if g), dm=model, dp=params,
+                    tag="_selfdraft")
+    return round(ratio, 4), breakdown
+
+
+def bench_decode_speculative_personalized(gamma=4, batch=8,
+                                          prompt_len=128, new_tokens=64,
+                                          page_size=16, k=256):
+    """The free personalized drafter: ``--speculate_k`` composed with
+    ``--serve_personalized`` on the paged server. The drafter snapshots
+    BASE params at server construction (personalization's admit returns
+    a new tree, so the snapshot never sees a user delta) while the
+    verify forward serves base + each admitted user's O(k) sparse
+    delta — the drafter costs nothing extra per user, and output is
+    still exactly the personalized target's greedy stream. Reports the
+    speculative-vs-plain throughput ratio on a personalized request
+    stream plus the base-drafter acceptance rate (how far k nonzeros of
+    delta move gpt2-small's argmax stream — a measured, publishable
+    number either way).
+
+    Dry-run runs the REAL composition contract at tiny scale: a
+    self-drafting speculative personalized server must reply bitwise
+    with the non-speculative personalized server over the same users.
+
+    Returns (speculative/plain tokens/s ratio, breakdown)."""
+    import jax
+    import jax.numpy as jnp
+
+    from commefficient_tpu.config import FedConfig
+    from commefficient_tpu.federated.client_store import (HostArenaStore,
+                                                          make_codec)
+    from commefficient_tpu.models.gpt2 import GPT2Config, GPT2DoubleHeads
+    from commefficient_tpu.serving import (ContinuousBatchingServer,
+                                           DecodeEngine,
+                                           PersonalizationIndex)
+
+    def sparse_store(d, n, cap):
+        cfg = FedConfig(mode="local_topk", error_type="local",
+                        client_state="sparse", k=cap,
+                        num_clients=n).finalize(d)
+        return HostArenaStore(cfg, make_codec(cfg))
+
+    if DRY_RUN:
+        gcfg = GPT2Config.tiny(vocab_size=256)
+        model = GPT2DoubleHeads(gcfg)
+        z = np.zeros((1, 1, 8), np.int32)
+        params = model.init(jax.random.PRNGKey(0), z, z,
+                            np.zeros((1, 1), np.int32),
+                            train=False)["params"]
+        d = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params))
+        rng = np.random.RandomState(3)
+        store = sparse_store(d, 4, 8)
+        for uid in range(4):
+            store.set_row("errors", uid, {
+                "idx": rng.choice(d, 8, replace=False).astype(np.int64),
+                "val": rng.randn(8).astype(np.float32)})
+        reqs = [([int(t) for t in rng.randint(1, 255, 6)], [1] * 6, uid)
+                for uid in range(4)]
+
+        def serve(spec_k):
+            # slots=1 serializes occupancy: active users' deltas share
+            # one params tree, so WHICH users are co-resident shifts
+            # logits, and speculation retires rows on a different
+            # schedule — the per-request contract is parity under the
+            # same co-residency, which one slot pins
+            eng = DecodeEngine(model, params, eos_id=255, max_len=32)
+            srv = ContinuousBatchingServer(
+                eng, slots=1, prefill_len=8, kv_cache="paged",
+                page_size=8, speculate_k=spec_k,
+                personalize=PersonalizationIndex(params, store))
+            for ids, types, uid in reqs:
+                srv.submit(ids, types, 2, 8, user_id=uid)
+            return srv.run()
+
+        assert serve(gamma) == serve(0), \
+            "personalized speculative replies diverged from plain"
+        return {"dry_run": "ok", "d": d}, {}
+
+    P, N = prompt_len, new_tokens
+    S = P + N
+    V = 50262
+    gcfg = GPT2Config.small(vocab_size=V)
+    gcfg.n_positions = max(gcfg.n_positions, S)
+    gcfg.dropout = 0.0
+    gcfg.dtype = "bfloat16"
+    model = GPT2DoubleHeads(gcfg)
+    key = jax.random.PRNGKey(0)
+    z = jnp.zeros((1, 1, 8), jnp.int32)
+    params = model.init(key, z, z, jnp.zeros((1, 1), jnp.int32),
+                        train=False)["params"]
+    d = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params))
+    rng = np.random.RandomState(0)
+    n_users = 2 * batch
+    store = sparse_store(d, n_users, k)
+    for uid in range(n_users):
+        cand = np.unique(rng.randint(0, d - k, 2 * k))[:k]
+        idx = np.concatenate([cand, np.arange(d - k, d - k + k -
+                                              cand.shape[0])])
+        val = (0.02 * rng.randn(k)).astype(np.float32)
+        val[val == 0.0] = 0.01
+        store.set_row("errors", uid,
+                      {"idx": idx.astype(np.int64), "val": val})
+    engine = DecodeEngine(model, params, eos_id=V - 1, max_len=S,
+                          method="greedy")
+    reqs = []
+    for uid in range(n_users):
+        L = int(rng.randint(P // 2, P + 1))
+        reqs.append((rng.randint(0, 50000, L).astype(np.int32).tolist(),
+                     [1] * L, uid))
+
+    breakdown = {"gamma": gamma, "batch": batch, "k": k,
+                 "prompt_len": P, "new_tokens": N}
+    tps = {}
+    for g in (0, gamma):
+        def make():
+            return ContinuousBatchingServer(
+                engine, slots=batch, prefill_len=P, kv_cache="paged",
+                page_size=page_size, speculate_k=g,
+                personalize=PersonalizationIndex(params, store))
+
+        warm = make()
+        warm.submit(reqs[0][0], reqs[0][1], 1, 2, user_id=reqs[0][2])
+        warm.run()
+        srv = make()
+        for ids, types, uid in reqs:
+            srv.submit(ids, types, 1, N, user_id=uid)
+        got = 0
+        t0 = time.perf_counter()
+        while srv._queue or any(r is not None for r in srv._slot_req):
+            for _, toks in srv.step():
+                got += len(toks)
+        dt = time.perf_counter() - t0
+        tps[g] = got / dt
+        breakdown[f"personalized_g{g}_tokens_per_sec"] = round(got / dt, 1)
+        if g:
+            st = srv.stats()
+            breakdown["base_drafter_acceptance_rate"] = round(
+                st["acceptance_rate"] or 0.0, 4)
+    return round(tps[gamma] / tps[0], 4), breakdown
+
+
 def bench_per_worker_sketch_ab(d=6_570_240, W=8, r=5, c=500_000):
     """BENCH_r08 A/B: the per-worker vmapped sketch — exactly the
     federated/client.py transmit shape, W workers' grads sketched under
@@ -1566,6 +1840,10 @@ def _bench_rows():
          lambda: bench_generate(batch=64)),
         ("gpt2_decode_paged_tokens_per_sec_ab",
          lambda: bench_decode_paged_ab()),
+        ("gpt2_decode_speculative_tokens_per_sec_ab",
+         lambda: bench_decode_speculative_ab()),
+        ("gpt2_decode_speculative_personalized_ab",
+         lambda: bench_decode_speculative_personalized()),
         ("serve_personalized_admission_overhead",
          lambda: bench_personalized_admission()),
     ]
@@ -1804,6 +2082,31 @@ def main():
                     "design — the users_per_chip_at_fixed_hbm_x entries "
                     "are the capacity win (ROADMAP item 1)"})
         if paged_ab is not None else None)
+    spec_ab = res["gpt2_decode_speculative_tokens_per_sec_ab"]
+    add("gpt2_decode_speculative_tokens_per_sec_ab",
+        round(spec_ab[0], 4) if spec_ab is not None else None,
+        "speedup_x",
+        dict(spec_ab[1], **{
+            "note": "--speculate_k over the paged server: γ tiny-drafter "
+                    "tokens + one multi-token verify vs γ=0, same greedy "
+                    "stream (bitwise — tests/test_speculative.py); the "
+                    "random drafter prices the mechanism, acceptance "
+                    "rates say what a distilled drafter must hit, the "
+                    "selfdraft arm is the ceiling; refutation at any γ "
+                    "is the measured answer"})
+        if spec_ab is not None else None)
+    spec_pers = res["gpt2_decode_speculative_personalized_ab"]
+    add("gpt2_decode_speculative_personalized_ab",
+        round(spec_pers[0], 4) if spec_pers is not None else None,
+        "speedup_x",
+        dict(spec_pers[1], **{
+            "note": "--speculate_k + --serve_personalized: base-weights "
+                    "drafter (free — the per-user delta is O(k) and "
+                    "admit never mutates the snapshot) vs plain "
+                    "personalized serving; base_drafter_acceptance_rate "
+                    "measures how far k-sparse deltas move the argmax "
+                    "stream"})
+        if spec_pers is not None else None)
     pers = res["serve_personalized_admission_overhead"]
     add("serve_personalized_admission_overhead",
         pers["admission_delta_apply_ms"] if pers is not None else None,

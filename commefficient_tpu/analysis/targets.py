@@ -733,6 +733,108 @@ def decode_paged_target(mutate: bool = False) -> AuditTarget:
         retrace=retrace)
 
 
+def decode_speculative_target(mutate: bool = False) -> AuditTarget:
+    """The speculative verify step over the paged pools
+    (serving/speculative.py ``_paged_verify_raw``).
+
+    Same contract as ``decode_paged``, extended to the multi-token
+    verify window: per-slot KV state lives ONLY in the page pools
+    reached through the traced page table, so a
+    ``(slots, max_len, H, hd)`` aval anywhere in the verify program is
+    the dense per-slot slab leaking back in, and a ``(slots, max_len)``
+    aval is its position-write mask.  Strict (no allowlist): the paged
+    verify's gathered pages stay 5-D end to end
+    (ops/attention.paged_verify_attention) and its γ+1 writes route
+    through the page table, so no legitimate eqn carries either shape.
+    The retrace guard drives a REAL speculative paged server —
+    admission churn, variable per-slot acceptance, mid-stream
+    rollbacks, page-boundary crossings — and asserts BOTH the verify
+    and the draft compile caches stay at one program (the per-slot-
+    variable-acceptance-via-masks invariant: acceptance length never
+    becomes a shape).
+
+    ``mutate=True`` traces the DENSE-cache verify (``_verify_raw``) at
+    the same dims — the program a dense-slab verify would produce — and
+    the audit must FAIL on it (tests/test_speculative.py pins this)."""
+    from commefficient_tpu.serving.speculative import SpeculativeDecoder
+
+    engine, S = _decode_engine()
+    B, gamma, page_size = 3, 3, 8
+    cfg = engine.model.config
+    spec = SpeculativeDecoder(engine, gamma=gamma, slots=B)
+    tok = jnp.asarray(np.full((B,), 5, np.int32))
+    typ = jnp.asarray(np.full((B,), 7, np.int32))
+    pos = jnp.asarray(np.array([3, 9, 1], np.int32))
+    drafts = jnp.asarray(np.full((B, gamma), 6, np.int32))
+    done = jnp.zeros((B,), bool)
+    max_pages = S // page_size
+    num_pages = 1 + B * max_pages
+
+    if mutate:
+        def trace():
+            return jax.make_jaxpr(spec._verify_raw)(
+                engine.params, engine.init_cache(B), tok, typ, pos,
+                drafts, done)
+    else:
+        def trace():
+            pools = engine.init_paged_pools(num_pages, page_size)
+            pt = jnp.zeros((B, max_pages), jnp.int32)
+            return jax.make_jaxpr(spec._paged_verify_raw)(
+                engine.params, pools, pt, tok, typ, pos, drafts, done)
+
+    def retrace():
+        from commefficient_tpu.serving import ContinuousBatchingServer
+        srv = ContinuousBatchingServer(engine, slots=B, prefill_len=16,
+                                       kv_cache="paged",
+                                       page_size=page_size,
+                                       speculate_k=gamma)
+        rs = np.random.RandomState(37)
+        V = cfg.vocab_size
+
+        def drive(i):
+            if len(srv._queue) < 2:
+                # fresh prompts/budgets every round: variable per-slot
+                # acceptance and mid-stream rollback must reuse the same
+                # two compiled programs
+                for _ in range(3):
+                    pl = int(rs.randint(3, 12))
+                    srv.submit([int(t) for t in rs.randint(0, V - 1, pl)],
+                               [7] * pl, 7, int(rs.randint(2, 8)))
+            srv.step()
+
+        report = check_retrace(srv.spec.paged_verify, None, repeats=3,
+                               warmup=1, drive=drive)
+        dsize = srv.spec.draft._cache_size()
+        if dsize > 1:
+            from .rules import Violation
+            report.ok = False
+            report.violations.append(Violation(
+                rule="retrace", path="", primitive="jit",
+                message=f"draft program compiled {dsize} variants — "
+                        f"acceptance length leaked into a shape"))
+        report.notes += f"; draft cache size {dsize}"
+        return report
+
+    slab = ShapePattern(("slots", "max_len", "H", "hd"),
+                        label="dense per-slot KV cache slab",
+                        allow_primitives=frozenset())
+    posmask = ShapePattern(("slots", "max_len"),
+                           label="dense per-slot position mask",
+                           allow_primitives=frozenset())
+    return AuditTarget(
+        name="decode_speculative/verify" + ("(mutated)" if mutate else ""),
+        description="speculative multi-token verify against page pools + "
+                    "traced page table; strict no-(slots, max_len, H, hd) "
+                    "ban; draft + verify caches must stay at one program"
+                    + (" [dense-cache verify mutation — must fail]"
+                       if mutate else ""),
+        trace=trace,
+        dims={"slots": B, "max_len": S, "H": cfg.n_head,
+              "hd": cfg.n_embd // cfg.n_head},
+        rules=(FootprintRule((slab, posmask)), TransferRule()),
+        retrace=retrace)
+
+
 # --------------------------------------------------------------------------
 # sketch ops
 # --------------------------------------------------------------------------
@@ -801,6 +903,8 @@ def build_targets(name: str) -> list:
         return [decode_target("step"), decode_target("generate")]
     if name == "decode_paged":
         return [decode_paged_target()]
+    if name == "decode_speculative":
+        return [decode_speculative_target()]
     if name == "client_store":
         return [client_store_target()]
     if name == "all":
@@ -809,7 +913,8 @@ def build_targets(name: str) -> list:
                 + build_targets("buffered") + build_targets("client_store")
                 + build_targets("gpt2") + build_targets("attention")
                 + build_targets("sketch") + build_targets("decode")
-                + build_targets("decode_paged"))
+                + build_targets("decode_paged")
+                + build_targets("decode_speculative"))
     raise ValueError(f"unknown audit target {name!r} (round|round_bucketed|"
                      f"sketch_batched|buffered|client_store|gpt2|attention|"
-                     f"sketch|decode|decode_paged|all)")
+                     f"sketch|decode|decode_paged|decode_speculative|all)")

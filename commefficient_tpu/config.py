@@ -119,6 +119,20 @@ class FedConfig:
     # checkpoint fingerprints carry the representation and
     # personalization_from_checkpoint refuses a mismatch at load.
     serve_personalized: bool = False
+    # Serving-time sampling method for the decode engine ('greedy' or
+    # 'topk'). Greedy is the default and the only method speculative
+    # decoding composes with (see speculate_k).
+    serve_sample: str = "greedy"
+    # Speculative decoding over the serving stack
+    # (serving/speculative.py): a small drafter proposes speculate_k
+    # tokens per slot and ONE multi-token target forward verifies all
+    # speculate_k+1 positions, accepting the longest matching prefix
+    # plus one corrected token — emitted tokens bitwise-identical to
+    # non-speculative greedy decode. 0 disables. Composes with
+    # kv_cache='paged' and serve_personalized (the base-weights drafter
+    # is free: the per-user delta is O(k), so draft with base, verify
+    # with base + delta).
+    speculate_k: int = 0
     # Offload pipeline depth (api.HostOffloadPipeline): how many rounds of
     # output rows may sit in the lazy-writeback queue while their (W, d)
     # device buffers stay alive. 2 = double buffering (gather round t+1 /
@@ -232,6 +246,21 @@ class FedConfig:
                 "weight deltas at serving time, which only the sparse "
                 "client-state rows provide; got client_state="
                 f"{self.client_state!r} — add --client_state sparse")
+        if self.serve_sample not in ("greedy", "topk"):
+            raise ValueError(f"serve_sample must be 'greedy' or 'topk', "
+                             f"got {self.serve_sample!r}")
+        if self.speculate_k < 0:
+            raise ValueError(
+                f"--speculate_k must be >= 0, got {self.speculate_k}: "
+                f"use a draft length >= 1 to speculate, or 0 to serve "
+                f"non-speculatively")
+        if self.speculate_k and self.serve_sample == "topk":
+            raise ValueError(
+                "--speculate_k uses greedy acceptance (the drafter's "
+                "argmax stream is verified against the target's), which "
+                "requires serve_sample='greedy'; topk sampling would "
+                "need the stochastic accept/resample rule — drop "
+                "--speculate_k or drop --serve_sample topk")
         if self.client_state == "sketched":
             if self.error_type != "local":
                 raise ValueError(

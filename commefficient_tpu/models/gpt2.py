@@ -124,11 +124,12 @@ class CausalSelfAttention(nn.Module):
     attn_dropout: str = "auto"    # 'auto' | 'output' | 'kernel'
 
     @nn.compact
-    def __call__(self, x, train: bool, cache=None, position=None):
+    def __call__(self, x, train: bool, cache=None, position=None,
+                 verify: bool = False):
         from commefficient_tpu.ops.attention import (
             blockwise_attention, decode_attention, full_attention,
             kernel_prob_dropout_eligible, paged_decode_attention,
-            ring_attention)
+            paged_verify_attention, ring_attention)
         B, T, C = x.shape
         qkv = nn.Dense(3 * C, dtype=self.dtype,
                        kernel_init=nn.initializers.normal(0.02))(x)
@@ -141,12 +142,18 @@ class CausalSelfAttention(nn.Module):
             raise ValueError(f"unknown attn_impl {self.attn_impl!r}")
         new_cache = None
         if cache is not None:
-            # KV-cached inference (docs/SERVING.md). Two static programs,
-            # keyed on T so each gets its own compile:
+            # KV-cached inference (docs/SERVING.md). Static programs,
+            # keyed on (T, verify) so each gets its own compile:
             #   T == 1  decode — write this token's k/v at the row's
             #           position (one-hot select: positions differ per
             #           row under continuous batching) and run one query
             #           against the whole cache, O(S) not O(S^2);
+            #   T  > 1, verify — speculative multi-token verify
+            #           (serving/speculative.py): T consecutive tokens
+            #           written at each row's OWN positions
+            #           position..position+T-1, attended with the decode
+            #           mask, so one forward scores a row's pending token
+            #           plus its drafted continuation;
             #   T  > 1  prefill from position 0 — causal self-attention
             #           within the prompt window (cache slots beyond it
             #           hold pad-derived garbage, masked/overwritten
@@ -159,27 +166,53 @@ class CausalSelfAttention(nn.Module):
             if "pt" in cache:
                 # Block-paged decode (serving/paged_cache.py): the layer
                 # cache is {"k": (num_pages, page_size, H, hd) pool, "v":
-                # likewise, "pt": (B, M) int32 page table}. This token's
-                # k/v scatter into the row's frontier page (host-allocated
+                # likewise, "pt": (B, M) int32 page table}. Each token's
+                # k/v scatter into the row's frontier pages (host-allocated
                 # before the step; free/done lanes point at the reserved
                 # garbage page 0, which is never attendable — the mask is
                 # by logical position). Prefill stays dense (B=1) and is
                 # packed into pages by DecodeEngine.paged_insert.
-                if T != 1:
+                if T != 1 and not verify:
                     raise ValueError(
-                        "paged KV cache decodes one token per step; "
+                        "paged KV cache decodes one token per step "
+                        "(or a verify=True multi-token window); "
                         "prefill runs dense and is packed host-side")
                 Pg = cache["k"].shape[1]
                 M = cache["pt"].shape[1]
-                p = jnp.minimum(position, M * Pg - 1)
-                phys = cache["pt"][jnp.arange(B), p // Pg]
-                off = p % Pg
+                b = jnp.arange(B)[:, None]
+                p = position[:, None] + jnp.arange(T)[None, :]  # (B, T)
+                # out-of-capacity writes route to the garbage page
+                # (physical page 0) INSTEAD of clipping: a clipped
+                # position would collide with the last real entry's
+                # scatter index, and duplicate-index scatter order is
+                # undefined. The garbage page absorbs them unattended.
+                in_range = p < M * Pg
+                pc = jnp.minimum(p, M * Pg - 1)
+                phys = jnp.where(in_range, cache["pt"][b, pc // Pg], 0)
+                off = pc % Pg
                 ck = cache["k"].at[phys, off].set(
-                    k[:, 0].astype(cache["k"].dtype))
+                    k.astype(cache["k"].dtype))
                 cv = cache["v"].at[phys, off].set(
-                    v[:, 0].astype(cache["v"].dtype))
-                y = paged_decode_attention(q, ck, cv, cache["pt"], p)
+                    v.astype(cache["v"].dtype))
+                y = paged_verify_attention(q, ck, cv, cache["pt"],
+                                           jnp.minimum(position,
+                                                       M * Pg - 1))
                 new_cache = {"k": ck, "v": cv, "pt": cache["pt"]}
+            elif verify and T > 1:
+                # dense-slab verify twin: scatter T rows at per-row
+                # positions with mode="drop" (out-of-capacity writes
+                # vanish rather than clip-collide), then the multi-query
+                # decode attention
+                S = cache["k"].shape[1]
+                b = jnp.arange(B)[:, None]
+                p = position[:, None] + jnp.arange(T)[None, :]  # (B, T)
+                ck = cache["k"].at[b, p].set(
+                    k.astype(cache["k"].dtype), mode="drop")
+                cv = cache["v"].at[b, p].set(
+                    v.astype(cache["v"].dtype), mode="drop")
+                y = decode_attention(q, ck, cv,
+                                     jnp.minimum(position, S - 1))
+                new_cache = {"k": ck, "v": cv}
             elif T == 1:
                 S = cache["k"].shape[1]
                 p = jnp.minimum(position, S - 1)
@@ -294,7 +327,8 @@ class Block(nn.Module):
                         kernel_init=nn.initializers.normal(0.02))(m)
 
     @nn.compact
-    def __call__(self, x, train: bool, cache=None, position=None):
+    def __call__(self, x, train: bool, cache=None, position=None,
+                 verify: bool = False):
         # epsilon matches HF GPT-2 (1e-5) so imported pretrained weights
         # reproduce reference logits (models/gpt2_import.py)
         ln = lambda t: nn.LayerNorm(dtype=self.dtype, epsilon=1e-5)(t)
@@ -311,7 +345,8 @@ class Block(nn.Module):
             nonlocal new_cache
             if cache is None:
                 return attn(h, train)
-            out, new_cache = attn(h, train, cache=cache, position=position)
+            out, new_cache = attn(h, train, cache=cache, position=position,
+                                  verify=verify)
             return out
 
         drop = lambda t: FusedDropout(self.dropout, self.dropout_impl,
@@ -337,15 +372,22 @@ class GPT2DoubleHeads(nn.Module):
     KV-cached inference: pass ``cache`` (init_decode_cache pytree),
     ``position`` and optionally ``logits_at`` with ``train=False`` to get
     (lm_logits (B*C, V), mc_logits, new_cache) — T>1 prefills the cache,
-    T==1 decodes one token per row against it (docs/SERVING.md). Cache
-    mode always materializes the per-position logits it returns, so
+    T==1 decodes one token per row against it (docs/SERVING.md).
+    ``verify=True`` with T>1 is the speculative multi-token verify
+    instead of prefill: the T tokens are a row's pending token plus its
+    drafted continuation, written at positions position..position+T-1
+    and attended with the decode mask; ``logits_all=True`` then returns
+    lm logits at ALL T positions, (B*C, T, V) with small static T =
+    speculate_k + 1 (serving/speculative.py). Cache mode always
+    materializes the per-position logits it returns, so
     ``fused_lm_head`` is irrelevant to it."""
     config: GPT2Config
 
     @nn.compact
     def __call__(self, input_ids, token_type_ids, mc_token_ids,
                  train: bool = True, cache=None, position=None,
-                 logits_at=None):
+                 logits_at=None, verify: bool = False,
+                 logits_all: bool = False):
         cfg = self.config
         if cfg.fused_lm_head and cfg.attn_impl == "ring":
             raise ValueError("fused_lm_head is not supported with "
@@ -383,6 +425,12 @@ class GPT2DoubleHeads(nn.Module):
             pos = pos + jax.lax.axis_index(cfg.seq_axis) * T
         elif cache is not None:
             pos = position[:, None] + pos      # per-row decode offsets
+            if verify:
+                # near-capacity rows may index past the position table
+                # (their emissions are capacity-masked by the verify
+                # program); clamp explicitly rather than relying on
+                # gather-clip semantics
+                pos = jnp.minimum(pos, cfg.n_positions - 1)
         x = wte(ids) + wpe(pos) + wte(types)
         x = FusedDropout(cfg.dropout, cfg.dropout_impl)(
             x, deterministic=not train)
@@ -405,13 +453,19 @@ class GPT2DoubleHeads(nn.Module):
                 x = blk(x, train)
             else:
                 x, layer_cache = blk(x, train, cache=cache[i],
-                                     position=position)
+                                     position=position, verify=verify)
                 new_cache.append(layer_cache)
         x = x.astype(jnp.float32)
         if not post_ln:
             x = nn.LayerNorm(epsilon=1e-5)(x)   # GPT-1 has no final LN
 
-        if cache is not None:
+        if cache is not None and logits_all:
+            # speculative verify: logits at ALL T positions, (B*C, T, V).
+            # T here is speculate_k + 1 — a handful — so this never
+            # approaches the (B, max_len, V) tensor the serving path
+            # exists to avoid.
+            lm_out = wte.attend(x)
+        elif cache is not None:
             # LM logits only at the sampled positions (tied wte head,
             # f32): (B*C, V), never (B*C, T, V)
             idx = (jnp.full((B * C,), T - 1, jnp.int32)

@@ -35,6 +35,13 @@ instead (models/gpt2.py's fallback).
   positions. O(S) per generated token; the KV-cached serving path
   (models/gpt2.py cache mode, commefficient_tpu/serving/) is built on it.
 
+* ``paged_verify_attention`` / ``paged_decode_attention`` — the same
+  decode mode against block-paged KV pools reached through a traced
+  page table, masked by logical position; the verify form takes
+  Tq = speculate_k + 1 queries per row (the speculative-decoding
+  multi-token verify, serving/speculative.py), the decode form is its
+  Tq = 1 alias.
+
 Layout: q/k/v are (B, T, H, D); causal masking uses GLOBAL positions, so
 shards mask correctly wherever they sit in the ring. ``kv_mask`` (B, T)
 marks valid (non-pad) keys.
@@ -126,10 +133,13 @@ def decode_attention(q, k, v, q_pos, *,
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
-def paged_decode_attention(q, k_pool, v_pool, page_table, q_pos) -> jax.Array:
-    """Single-query attention against a block-paged KV cache.
+def paged_verify_attention(q, k_pool, v_pool, page_table, q_pos) -> jax.Array:
+    """Multi-query attention against a block-paged KV cache.
 
-    ``q`` is (B, Tq, H, D) with small static Tq (1 for serving);
+    ``q`` is (B, Tq, H, D) with small static Tq — 1 for token-by-token
+    decode, ``speculate_k + 1`` for the speculative verify forward
+    (serving/speculative.py), where the target model scores a row's
+    pending token plus its drafted continuation in ONE forward;
     ``k_pool``/``v_pool`` are the shared page pools, (num_pages,
     page_size, H, D); ``page_table`` (B, M) int32 maps each row's
     logical page m to a physical pool page (physical page 0 is the
@@ -140,14 +150,16 @@ def paged_decode_attention(q, k_pool, v_pool, page_table, q_pos) -> jax.Array:
     its (B, S, H, D) cache — the mask is by LOGICAL position
     ``m * page_size + p <= q_pos[b] + t``, which covers garbage-page
     reads by construction (an unallocated logical page lies entirely
-    above the row's position).
+    above the row's position) and keeps rejected speculative entries
+    above a row's accepted frontier unattendable until overwritten.
 
     The gathered pages stay 5-D (B, M, P, H, D) end to end — they are
     never reshaped to a (B, S, H, D) slab, so the per-step working set
     is the gather plus (B, H, Tq, M, P) scores and the ``decode_paged``
-    audit's forbidden dense-cache shape cannot appear. f32 scores via
-    MXU accumulation (see full_attention); the (m, p) contraction runs
-    in logical order, matching the dense path's key order."""
+    / ``decode_speculative`` audits' forbidden dense-cache shape cannot
+    appear. f32 scores via MXU accumulation (see full_attention); the
+    (m, p) contraction runs in logical order, matching the dense path's
+    key order."""
     B, Tq, H, D = q.shape
     P = k_pool.shape[1]
     M = page_table.shape[1]
@@ -163,6 +175,15 @@ def paged_decode_attention(q, k_pool, v_pool, page_table, q_pos) -> jax.Array:
         s.reshape(B, H, Tq, M * P).astype(jnp.float32), axis=-1)
     p = p.reshape(B, H, Tq, M, P).astype(q.dtype)
     return jnp.einsum("bhqmp,bmphd->bqhd", p, v)
+
+
+def paged_decode_attention(q, k_pool, v_pool, page_table, q_pos) -> jax.Array:
+    """Single-query (Tq == 1) decode against the paged cache — a pure
+    delegation to ``paged_verify_attention``, which is the same math at
+    general Tq (identical einsums, so the Tq=1 trace is bitwise the
+    pre-speculative program). Kept as the named decode entry point the
+    serving step and its docs refer to."""
+    return paged_verify_attention(q, k_pool, v_pool, page_table, q_pos)
 
 
 def _fold_block(acc, q, kb, vb, q_pos, k_pos, kv_mask_b, causal):

@@ -5,10 +5,12 @@ for every row — dense-slab or block-paged — and a whole-reply
 ``lax.scan`` generate); ``ContinuousBatchingServer`` drives the step
 program over a fixed slot array, admitting and retiring requests
 between jitted steps, optionally against the paged KV pools of
-``PagedKVCache`` and with per-user weight deltas from a
-``PersonalizationIndex``. See docs/SERVING.md for the cache layouts,
-the slot lifecycle, and the invariants the ``decode`` and
-``decode_paged`` graft-audit targets enforce.
+``PagedKVCache``, with per-user weight deltas from a
+``PersonalizationIndex``, and with a ``SpeculativeDecoder`` drafting
+γ tokens per slot ahead of each multi-token verify. See
+docs/SERVING.md for the cache layouts, the slot lifecycle, and the
+invariants the ``decode``, ``decode_paged`` and ``decode_speculative``
+graft-audit targets enforce.
 """
 
 from commefficient_tpu.serving.decode import DecodeEngine
@@ -16,7 +18,10 @@ from commefficient_tpu.serving.paged_cache import GARBAGE_PAGE, PagedKVCache
 from commefficient_tpu.serving.personalize import (
     PersonalizationIndex, personalization_from_checkpoint)
 from commefficient_tpu.serving.server import ContinuousBatchingServer
+from commefficient_tpu.serving.speculative import (
+    SpeculativeDecoder, speculation_from_checkpoint)
 
 __all__ = ["DecodeEngine", "ContinuousBatchingServer", "PagedKVCache",
            "GARBAGE_PAGE", "PersonalizationIndex",
-           "personalization_from_checkpoint"]
+           "personalization_from_checkpoint", "SpeculativeDecoder",
+           "speculation_from_checkpoint"]

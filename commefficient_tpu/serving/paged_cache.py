@@ -166,6 +166,47 @@ class PagedKVCache:
         self.pos[slot] = min(self.pos[slot] + 1,
                              self.max_pages * self.page_size - 1)
 
+    # ---- speculative decoding (serving/speculative.py) ----------------
+
+    def ensure_range(self, slot: int, upto_pos: int) -> None:
+        """Guarantee pages covering positions [pos, upto_pos] are
+        allocated (private) — the speculative verify writes a row's
+        pending token plus its drafted continuation in one step, so the
+        frontier may span more than one page. Positions beyond logical
+        capacity need no page: the verify program routes their writes
+        to the garbage page."""
+        P = self.page_size
+        m_lo = int(self.pos[slot]) // P
+        m_hi = min(int(upto_pos), self.max_pages * P - 1) // P
+        for m in range(m_lo, m_hi + 1):
+            if self.table[slot, m] == GARBAGE_PAGE:
+                self.table[slot, m] = self._alloc()
+
+    def truncate(self, slot: int, new_pos: int) -> None:
+        """Roll back rejected speculative entries: set the slot's
+        position to the accepted frontier and free any allocated pages
+        that lie entirely above it — pure host bookkeeping, no device
+        work. The freed pages still hold stale speculative k/v, which
+        is safe: a page is only reattendable after reallocation, and
+        admission packs / verify scatters overwrite it before any
+        logical position inside it becomes attendable (the mask is by
+        logical position).
+
+        Pages at or below the frontier page are untouched — they hold
+        accepted entries, possibly shared prompt pages. Pages above it
+        are always private (allocated by ensure_range/ensure_frontier,
+        never entered into the prefix-sharing key map), so the unref
+        here frees them immediately."""
+        P = self.page_size
+        cap = self.max_pages * P
+        self.pos[slot] = min(int(new_pos), cap - 1)
+        frontier_m = min(int(new_pos), cap - 1) // P
+        row = self.table[slot]
+        for m in range(frontier_m + 1, self.max_pages):
+            if row[m] != GARBAGE_PAGE:
+                self._unref(int(row[m]))
+                row[m] = GARBAGE_PAGE
+
     def release(self, slot: int) -> None:
         """Return ``slot``'s pages (decref — shared pages free only when
         the last sharer leaves) and point the row back at garbage."""

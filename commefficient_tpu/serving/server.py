@@ -37,6 +37,17 @@ audit target and tests/test_paged_serving.py hold both). Passing
 per-user sparse weight delta at admission and subtracts it at
 retirement, so requests carrying ``user_id`` decode under base + that
 user's delta while base params stay shared.
+
+``speculate_k=γ`` turns each step into a speculative round
+(serving/speculative.py): one jitted DRAFT program proposes γ tokens
+per slot from a small drafter's own dense cache, one jitted VERIFY
+program runs the target over all γ+1 positions (through the paged
+pools when ``kv_cache="paged"``) and accepts the longest matching
+prefix plus one corrected token in-program — up to γ+1 tokens per
+target forward, emitted stream bitwise-identical to the non-speculative
+greedy stream. Rejected paged entries roll back host-side
+(``PagedKVCache.truncate``). Still exactly one draft + one verify
+program for the server's lifetime, and still ONE host pull per step.
 """
 
 from __future__ import annotations
@@ -65,7 +76,9 @@ class ContinuousBatchingServer:
     def __init__(self, engine, *, slots: int = 8, prefill_len: int = 64,
                  seed: int = 0, kv_cache: str = "fixed",
                  page_size: int = 16, num_pages: int = None,
-                 share_prefix: bool = True, personalize=None):
+                 share_prefix: bool = True, personalize=None,
+                 speculate_k: int = 0, drafter_model=None,
+                 drafter_params=None):
         if prefill_len > engine.max_len:
             raise ValueError(f"prefill_len {prefill_len} exceeds cache "
                              f"capacity {engine.max_len}")
@@ -105,6 +118,24 @@ class ContinuousBatchingServer:
         self._insert = jax.jit(self._insert_raw)
         self._set_row = jax.jit(self._set_row_raw)
         self._release = jax.jit(self._release_raw)
+        self.spec = None
+        if speculate_k:
+            from commefficient_tpu.serving.speculative import \
+                SpeculativeDecoder
+
+            # constructed BEFORE any personalized admission, so the
+            # default (self-drafting) drafter snapshots pristine base
+            # params — the free personalized drafter
+            self.spec = SpeculativeDecoder(
+                engine, gamma=speculate_k, slots=B,
+                drafter_model=drafter_model, drafter_params=drafter_params)
+            self.prev_tok = jnp.full((B,), engine.pad_id, jnp.int32)
+            self.prev_typ = jnp.zeros((B,), jnp.int32)
+            self._set_prev = jax.jit(self._set_prev_raw)
+            self._drafted = np.zeros((B,), np.int64)
+            self._accepted = np.zeros((B,), np.int64)
+            self._spec_totals = {"drafted": 0, "accepted": 0,
+                                 "corrected": 0, "rounds": 0}
 
     # ---- jitted slot surgery (slot index is TRACED: no per-slot
     # recompiles, which the decode audit target's retrace guard relies
@@ -125,6 +156,10 @@ class ContinuousBatchingServer:
     @staticmethod
     def _release_raw(done, slot):
         return done.at[slot].set(True)
+
+    @staticmethod
+    def _set_prev_raw(prev_tok, prev_typ, slot, t, ty):
+        return prev_tok.at[slot].set(t), prev_typ.at[slot].set(ty)
 
     # ---- request lifecycle -------------------------------------------
 
@@ -199,6 +234,22 @@ class ContinuousBatchingServer:
             self.tok, self.typ, self.pos, self.done = self._set_row(
                 self.tok, self.typ, self.pos, self.done, jnp.int32(slot),
                 jnp.int32(t), jnp.int32(req.reply_type), jnp.int32(L))
+            if self.spec is not None:
+                # drafter twin of the target prefill — always BASE
+                # params, so a personalized admission drafts for free
+                drow = self.spec.dprefill(
+                    self.spec.dparams, self.spec.init_drafter_row(),
+                    jnp.asarray(ids), jnp.asarray(typ),
+                    jnp.asarray([L - 1], jnp.int32))
+                self.spec.dcache = self._insert(self.spec.dcache, drow,
+                                                jnp.int32(slot))
+                # next catch-up rewrites the last PROMPT token at L-1
+                self.prev_tok, self.prev_typ = self._set_prev(
+                    self.prev_tok, self.prev_typ, jnp.int32(slot),
+                    jnp.int32(int(req.ids[-1])),
+                    jnp.int32(int(req.types[-1])))
+                self._drafted[slot] = 0
+                self._accepted[slot] = 0
             self._slot_req[slot] = req
         return finished
 
@@ -219,6 +270,8 @@ class ContinuousBatchingServer:
         active = [s for s, r in enumerate(self._slot_req) if r is not None]
         if not active:
             return finished
+        if self.spec is not None:
+            return self._speculative_round(active, finished)
         if self.pager is not None:
             for slot in active:
                 self.pager.ensure_frontier(slot)
@@ -245,6 +298,82 @@ class ContinuousBatchingServer:
             if len(req.out) >= req.max_new:
                 self._retire(slot, finished)
         return finished
+
+    def _speculative_round(self, active, finished):
+        """One draft + verify round over the whole slot array: up to
+        γ+1 tokens per active slot, same two programs every round."""
+        spec, eng = self.spec, self.engine
+        spec.dcache, drafts = spec.draft(
+            spec.dparams, spec.dcache, self.prev_tok, self.prev_typ,
+            self.tok, self.typ, self.pos)
+        if self.pager is not None:
+            for slot in active:
+                # pages covering the whole verify window [pos, pos+γ];
+                # writes past logical capacity route to the garbage page
+                self.pager.ensure_range(
+                    slot, int(self.pager.pos[slot]) + spec.gamma)
+            pt = self.pager.device_table()
+            (self.cache, emitted, acc, self.tok, self.prev_tok,
+             self.pos, self.done) = spec.paged_verify(
+                eng.params, self.cache, pt, self.tok, self.typ,
+                self.pos, drafts, self.done)
+        else:
+            (self.cache, emitted, acc, self.tok, self.prev_tok,
+             self.pos, self.done) = spec.verify(
+                eng.params, self.cache, self.tok, self.typ, self.pos,
+                drafts, self.done)
+        # every verified token came out of the TARGET's argmax stream,
+        # so the verify round leaves prev pointing at a reply-typed token
+        self.prev_typ = self.typ
+        em, ac, ph = jax.device_get((emitted, acc, self.pos))  # ONE pull
+        for slot in active:
+            req = self._slot_req[slot]
+            a = int(ac[slot])
+            self._spec_totals["rounds"] += 1
+            self._spec_totals["drafted"] += spec.gamma
+            self._spec_totals["accepted"] += max(a - 1, 0)
+            self._spec_totals["corrected"] += min(a, 1)
+            self._drafted[slot] += spec.gamma
+            self._accepted[slot] += max(a - 1, 0)
+            if a == 0:
+                # the row latched done in an EARLIER round (capacity):
+                # the non-speculative server would emit eos now — retire
+                self._retire(slot, finished)
+                continue
+            retired = False
+            for t in em[slot, :a]:
+                t = int(t)
+                if t == eng.eos_id:
+                    self._retire(slot, finished)
+                    retired = True
+                    break
+                req.out.append(t)
+                if len(req.out) >= req.max_new:
+                    self._retire(slot, finished)
+                    retired = True
+                    break
+            if not retired and self.pager is not None:
+                # roll rejected speculative pages back to the accepted
+                # frontier — host bookkeeping only
+                self.pager.truncate(slot, int(ph[slot]))
+        return finished
+
+    def stats(self) -> Dict[str, object]:
+        """Speculation counters: drafted/accepted/corrected totals, the
+        aggregate acceptance rate (accepted drafts / drafted), and the
+        per-slot acceptance rate over each slot's CURRENT occupancy
+        (None for slots that have not drafted since admission)."""
+        if self.spec is None:
+            return {"speculate_k": 0}
+        s = dict(self._spec_totals)
+        s["speculate_k"] = self.spec.gamma
+        s["acceptance_rate"] = (s["accepted"] / s["drafted"]
+                                if s["drafted"] else None)
+        s["per_slot_acceptance"] = [
+            (float(self._accepted[i] / self._drafted[i])
+             if self._drafted[i] else None)
+            for i in range(self.slots)]
+        return s
 
     def run(self, max_steps: int = 100_000) -> Dict[int, List[int]]:
         """Step until every submitted request has a reply."""

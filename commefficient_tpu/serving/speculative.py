@@ -1,0 +1,278 @@
+"""Speculative decoding over the serving stack (ROADMAP item 1).
+
+Greedy decode pays one full target forward per emitted token and is
+memory-bandwidth-bound (docs/ROOFLINE.md): the chip streams the whole
+parameter set + KV cache through HBM to produce one token. Speculative
+decoding (Leviathan et al., ICML 2023; Chen et al., 2023 — PAPERS.md
+"Serving") turns ``gamma`` cheap DRAFTER steps plus ONE target forward
+into up to ``gamma + 1`` accepted tokens, with output that is provably
+identical to non-speculative decoding under greedy acceptance:
+
+* a small drafter (``GPT2Config.tiny()``-class, its own dense KV cache)
+  proposes ``gamma`` greedy continuation tokens per slot;
+* the target model verifies all ``gamma + 1`` positions — the row's
+  pending token plus the drafts — in a SINGLE multi-token forward
+  through its cache (dense slab or block-paged pools:
+  ``ops/attention.paged_verify_attention`` gathers T = gamma+1 queries
+  through the page table, masked by logical position);
+* greedy acceptance keeps the longest prefix of drafts that matches the
+  target's own argmax stream, plus one corrected/bonus token from the
+  target. Every emitted token is a target argmax, so the emitted stream
+  is the non-speculative greedy stream (the bitwise regression harness
+  in tests/test_speculative.py).
+
+Rejected speculative KV entries are rolled back as pure host
+bookkeeping: the dense/paged write masks make entries above a row's
+accepted frontier unattendable until overwritten, and
+``PagedKVCache.truncate`` frees the frontier pages past the accepted
+position — no device work. Per-slot variable acceptance is handled with
+masks INSIDE the jitted verify program, never with shape changes, so
+the server holds exactly ONE compiled draft program and ONE compiled
+verify program for its lifetime (the PR 13 invariant; the
+``decode_speculative`` graft-audit target pins it).
+
+The catch-up protocol keeps the drafter's cache consistent across
+rounds without per-acceptance-length programs: each draft round first
+(re)feeds the accepted-stream token at ``pos - 1`` — an idempotent
+rewrite when that position is already cached (causal k/v at position i
+depend only on tokens <= i), and the missing write after a
+full-acceptance round, where the drafter never consumed its own last
+draft — then feeds the pending token at ``pos`` and greedily self-feeds
+``gamma - 1`` more times.
+
+With ``--serve_personalized`` the drafter is FREE: it runs the BASE
+weights (snapshotted before any per-user delta is applied — the
+FetchSGD sparse residual is an O(k) delta, so base params stay pristine
+under admission), while the verify forward runs the personalized
+params. Draft quality degrades only as far as the user's delta moves
+the argmax stream; output correctness never does, because acceptance
+only ever emits the (personalized) target's argmax.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from commefficient_tpu.models.gpt2 import init_decode_cache
+
+
+def drafter_fingerprint(config) -> dict:
+    """The drafter-identity record a serving checkpoint carries: the
+    architecture axes that determine whether a drafter checkpoint's
+    params can draft for this server at all."""
+    return {"arch": config.arch, "vocab_size": int(config.vocab_size),
+            "n_positions": int(config.n_positions),
+            "n_embd": int(config.n_embd),
+            "n_layer": int(config.n_layer),
+            "n_head": int(config.n_head)}
+
+
+def speculation_from_checkpoint(fingerprint: Optional[dict],
+                                drafter_config, *,
+                                speculate_k: int) -> int:
+    """Gate ``--speculate_k`` on a checkpoint's drafter fingerprint.
+
+    Returns the effective speculate_k: unchanged when the checkpoint's
+    ``drafter`` record matches ``drafter_config``, and 0 — serve
+    NON-speculative, with a warning — when the record is missing
+    (legacy checkpoint, or one saved without a drafter) or disagrees.
+    A mismatched drafter cannot corrupt output (acceptance only emits
+    target argmaxes) but would silently draft near-zero acceptance, so
+    the server degrades to plain decoding loudly instead. Mirrors
+    ``personalization_from_checkpoint``'s warn-and-degrade contract.
+    """
+    if speculate_k < 1:
+        return 0
+    if fingerprint is None or "drafter" not in fingerprint:
+        warnings.warn(
+            "checkpoint fingerprint has no drafter record (legacy "
+            "checkpoint, or trained without a drafter) — serving "
+            "non-speculative; re-save the checkpoint with a drafter "
+            "fingerprint to enable --speculate_k", stacklevel=2)
+        return 0
+    want = drafter_fingerprint(drafter_config)
+    got = fingerprint["drafter"]
+    if got != want:
+        warnings.warn(
+            f"checkpoint drafter fingerprint {got} does not match the "
+            f"served drafter config {want} — serving non-speculative; "
+            f"point --speculate_k at the drafter the checkpoint was "
+            f"saved with", stacklevel=2)
+        return 0
+    return int(speculate_k)
+
+
+class SpeculativeDecoder:
+    """Draft + verify programs for one (target engine, drafter) pair.
+
+    ``gamma`` drafts per round; ``slots`` sizes the drafter's own dense
+    KV cache (the drafter is tiny, so its dense slab is cheap even when
+    the target cache is paged). Defaults to SELF-drafting — drafter
+    model/params are the target's, snapshotted at construction — which
+    is the testing configuration (100% acceptance, bitwise parity) and
+    the personalized-serving configuration (the snapshot is the base
+    params; the verify forward reads ``engine.params``, which carries
+    the active per-user deltas).
+    """
+
+    def __init__(self, engine, *, gamma: int, slots: int,
+                 drafter_model=None, drafter_params=None):
+        if gamma < 1:
+            raise ValueError(
+                f"speculate_k must be >= 1 to speculate, got {gamma}; "
+                f"use 0 (or omit the flag) to serve non-speculatively")
+        if engine.method != "greedy":
+            raise ValueError(
+                "speculative decoding is greedy-only for now: acceptance "
+                "compares the drafter's argmax stream against the "
+                "target's, and topk sampling would need the stochastic "
+                "accept/resample rule — drop --speculate_k or serve "
+                "with method='greedy'")
+        self.engine = engine
+        self.gamma = int(gamma)
+        self.slots = int(slots)
+        self.dmodel = drafter_model if drafter_model is not None \
+            else engine.model
+        # the base-params snapshot: personalization's admit returns a NEW
+        # tree (serving/personalize.py), so this reference stays pristine
+        # while engine.params accumulates per-user deltas
+        self.dparams = drafter_params if drafter_params is not None \
+            else engine.params
+        dcfg = self.dmodel.config
+        tcfg = engine.model.config
+        if dcfg.vocab_size != tcfg.vocab_size:
+            raise ValueError(
+                f"drafter vocab {dcfg.vocab_size} != target vocab "
+                f"{tcfg.vocab_size}: draft tokens must be target tokens")
+        if dcfg.n_positions < engine.max_len:
+            raise ValueError(
+                f"drafter n_positions {dcfg.n_positions} < server "
+                f"max_len {engine.max_len}: the drafter must cover every "
+                f"position the target can decode at")
+        self.dcache = init_decode_cache(dcfg, self.slots, engine.max_len)
+        # one compile each for the server's lifetime (asserted via
+        # _cache_size() in tests and the decode_speculative audit)
+        self.draft = jax.jit(self._draft_raw)
+        self.verify = jax.jit(self._verify_raw)
+        self.paged_verify = jax.jit(self._paged_verify_raw)
+        self.dprefill = jax.jit(self._dprefill_raw)
+
+    # ---- drafter programs --------------------------------------------
+
+    def init_drafter_row(self):
+        return init_decode_cache(self.dmodel.config, 1, self.engine.max_len)
+
+    def _dapply(self, dparams, ids2d, types2d, dcache, pos, logits_at):
+        B = ids2d.shape[0]
+        logits, _, dcache = self.dmodel.apply(
+            {"params": dparams}, ids2d[:, None, :], types2d[:, None, :],
+            jnp.zeros((B, 1), jnp.int32), train=False,
+            cache=dcache, position=pos, logits_at=logits_at)
+        return logits, dcache
+
+    def _dprefill_raw(self, dparams, dcache, ids, types, last_idx):
+        """Fill a B=1 drafter cache row from the padded prompt — the
+        drafter twin of the engine's admission prefill (its logits are
+        discarded: the first token is sampled from the TARGET)."""
+        pos0 = jnp.zeros((ids.shape[0],), jnp.int32)
+        _, dcache = self._dapply(dparams, ids, types, dcache, pos0,
+                                 last_idx)
+        return dcache
+
+    def _draft_raw(self, dparams, dcache, prev_tok, prev_typ, tok,
+                   type_tok, pos):
+        """One draft round: gamma + 1 single-token drafter forwards in
+        ONE program. Step 0 is the catch-up (re)write of the accepted
+        token at pos - 1 (idempotent when already cached; the missing
+        write after full acceptance); then the pending token feeds at
+        ``pos`` and the drafter greedily self-feeds. Returns
+        (dcache, drafts (B, gamma))."""
+        zero = jnp.zeros_like(tok)
+        _, dcache = self._dapply(dparams, prev_tok[:, None],
+                                 prev_typ[:, None], dcache,
+                                 jnp.maximum(pos - 1, 0), zero)
+        drafts = []
+        cur, p = tok, pos
+        for _ in range(self.gamma):
+            logits, dcache = self._dapply(dparams, cur[:, None],
+                                          type_tok[:, None], dcache, p,
+                                          zero)
+            cur = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            drafts.append(cur)
+            p = p + 1
+        return dcache, jnp.stack(drafts, axis=1)
+
+    # ---- target verify + in-program greedy acceptance -----------------
+
+    def _accept(self, ids, tstar, pos, done):
+        """Greedy acceptance over the verified window, fully masked —
+        per-slot variable acceptance without shape changes.
+
+        ``ids`` (B, gamma+1) is [pending tok, d_1..d_gamma]; ``tstar``
+        the target's argmax at each position. Emission j (= tstar[j])
+        is realized iff the row is live, every earlier draft matched
+        (d_i == tstar[i-1]), no earlier emission was eos, and the
+        previous emission did not hit cache capacity — exactly the
+        non-speculative step's emit/latch schedule, token for token."""
+        B, G1 = ids.shape
+        eos = jnp.int32(self.engine.eos_id)
+        max_len = self.engine.max_len
+        ones = jnp.ones((B, 1), bool)
+        match = jnp.concatenate([ones, ids[:, 1:] == tstar[:, :-1]], 1)
+        no_eos = jnp.concatenate([ones, tstar[:, :-1] != eos], 1)
+        cap = pos[:, None] + jnp.arange(G1)[None, :] < max_len
+        live = match & no_eos & cap & ~done[:, None]
+        alive = jnp.cumprod(live.astype(jnp.int32), axis=1).astype(bool)
+        acc = alive.sum(axis=1).astype(jnp.int32)          # (B,) in [0, G1]
+        emitted = jnp.where(alive, tstar, eos)
+        last_idx = jnp.maximum(acc - 1, 0)[:, None]
+        last = jnp.take_along_axis(tstar, last_idx, axis=1)[:, 0]
+        # token now at new_pos - 1: the last ACCEPTED input (ids[acc-1]),
+        # i.e. the pending tok when only the correction was taken —
+        # next round's catch-up token
+        new_prev = jnp.take_along_axis(ids, last_idx, axis=1)[:, 0]
+        new_done = done | (last == eos) | (pos + acc >= max_len)
+        new_tok = jnp.where(new_done, eos, last)
+        new_pos = jnp.minimum(pos + acc, max_len - 1)
+        return emitted, acc, new_tok, new_prev, new_pos, new_done
+
+    def _verify_core(self, params, cache, tok, type_tok, pos, drafts,
+                     done):
+        eng = self.engine
+        ids = jnp.concatenate([tok[:, None], drafts], axis=1)
+        B, G1 = ids.shape
+        types = jnp.broadcast_to(type_tok[:, None], (B, G1))
+        lm, _, cache = eng.model.apply(
+            {"params": params}, ids[:, None, :], types[:, None, :],
+            jnp.zeros((B, 1), jnp.int32), train=False, cache=cache,
+            position=pos, verify=True, logits_all=True)
+        tstar = jnp.argmax(lm, axis=-1).astype(jnp.int32)  # (B, gamma+1)
+        return cache, ids, tstar
+
+    def _verify_raw(self, params, cache, tok, type_tok, pos, drafts,
+                    done):
+        """Verify gamma+1 positions through the DENSE slot cache in one
+        multi-token forward; acceptance in-program. Returns
+        (cache, emitted (B, gamma+1), acc (B,), new_tok, new_prev,
+        new_pos, new_done)."""
+        cache, ids, tstar = self._verify_core(params, cache, tok,
+                                              type_tok, pos, drafts, done)
+        return (cache,) + self._accept(ids, tstar, pos, done)
+
+    def _paged_verify_raw(self, params, pools, pt, tok, type_tok, pos,
+                          drafts, done):
+        """The paged twin: pools + traced page table, multi-token writes
+        routed through the table (out-of-capacity writes land on the
+        garbage page), attention via paged_verify_attention. The host
+        allocates frontier pages covering pos..pos+gamma beforehand
+        (PagedKVCache.ensure_range) and rolls rejected entries back
+        afterwards (truncate) — both pure bookkeeping."""
+        cache = tuple({"k": p["k"], "v": p["v"], "pt": pt} for p in pools)
+        cache, ids, tstar = self._verify_core(params, cache, tok,
+                                              type_tok, pos, drafts, done)
+        new_pools = tuple({"k": c["k"], "v": c["v"]} for c in cache)
+        return (new_pools,) + self._accept(ids, tstar, pos, done)

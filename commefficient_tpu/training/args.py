@@ -147,6 +147,24 @@ def build_parser(default_lr: float = 0.4) -> argparse.ArgumentParser:
                         "coordinate rows); checkpoint fingerprints "
                         "record the representation and loading refuses "
                         "a mismatch")
+    p.add_argument("--serve_sample", choices=("greedy", "topk"),
+                   default="greedy",
+                   help="serving-time sampling method for the decode "
+                        "engine; greedy is the only method "
+                        "--speculate_k composes with")
+    p.add_argument("--speculate_k", type=int, default=0,
+                   help="speculative decoding draft length γ "
+                        "(serving/speculative.py): a small drafter "
+                        "proposes γ tokens per slot and one multi-token "
+                        "target forward verifies all γ+1 positions, "
+                        "emitting the longest accepted prefix plus one "
+                        "corrected token — output bitwise-identical to "
+                        "non-speculative greedy decode. 0 disables. "
+                        "Greedy-only; composes with paged KV caches and "
+                        "--serve_personalized (base-weights drafter is "
+                        "free). Checkpoint fingerprints record the "
+                        "drafter; a mismatch warns and serves "
+                        "non-speculative")
     p.add_argument("--offload_pipeline_depth", type=int, default=2,
                    help="rounds of offloaded output rows that may queue "
                         "for lazy host writeback (api.HostOffloadPipeline)"

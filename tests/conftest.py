@@ -29,6 +29,35 @@ from commefficient_tpu.federated import api as _fed_api  # noqa: E402
 _fed_api.set_transfer_guard("disallow")
 
 
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def serving_tiny_engine():
+    """ONE tiny byte-tokenizer DecodeEngine shared by the serving test
+    modules (test_paged_serving, test_speculative). Engine jits are
+    per-instance, so sharing the instance shares every warm program —
+    prefill, step, pack, and the solo-generate reference — across the
+    files instead of recompiling them per module. test_paged_serving
+    collects first and owns the exact compile-count asserts against the
+    fresh caches."""
+    import numpy as np
+
+    from commefficient_tpu.data.tokenizer import ByteTokenizer
+    from commefficient_tpu.models.gpt2 import GPT2Config, GPT2DoubleHeads
+    from commefficient_tpu.serving import DecodeEngine
+    tok = ByteTokenizer()
+    cfg = GPT2Config.tiny(vocab_size=tok.vocab_size)
+    model = GPT2DoubleHeads(cfg)
+    ids = np.zeros((1, 1, 8), np.int32)
+    params = model.init(jax.random.PRNGKey(0), ids, ids,
+                        np.zeros((1, 1), np.int32), train=False)["params"]
+    eos = tok.convert_tokens_to_ids("<eos>")
+    engine = DecodeEngine(model, params, eos_id=eos, max_len=48,
+                          method="greedy")
+    return tok, model, params, engine
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",

@@ -34,7 +34,7 @@ def test_registry_covers_every_row():
     a row cannot exist in one mode and be silently skipped by the
     other."""
     names = [n for n, _ in bench._bench_rows()]
-    assert len(names) == len(set(names)) == 25
+    assert len(names) == len(set(names)) == 27
     for must in ("cifar10_resnet9_fed_rounds_per_sec",
                  "cifar10_resnet9_per_worker_sketch_ab",
                  "gpt2_fetchsgd_per_worker_sketch_ab",
@@ -54,6 +54,8 @@ def test_registry_covers_every_row():
                  "gpt2_decode_tokens_per_sec_chip_b8",
                  "gpt2_decode_tokens_per_sec_chip_b64",
                  "gpt2_decode_paged_tokens_per_sec_ab",
+                 "gpt2_decode_speculative_tokens_per_sec_ab",
+                 "gpt2_decode_speculative_personalized_ab",
                  "serve_personalized_admission_overhead"):
         assert must in names
 
@@ -124,6 +126,18 @@ def test_paged_decode_row_traces_pack_and_step(dry):
     page-table-traced paged step both trace via eval_shape — kv-pool or
     page-table signature drift fails here on CPU."""
     status, breakdown = bench.bench_decode_paged_ab()
+    assert status["dry_run"] == "ok"
+    assert status["out_leaves"] > 0
+    assert breakdown == {}
+
+
+def test_speculative_decode_row_traces_draft_and_paged_verify(dry):
+    """The speculative A/B row: the γ-draft program and the paged
+    multi-token verify both trace via eval_shape — drafter-cache or
+    verify-window signature drift fails here on CPU. (The personalized
+    variant's dry run compiles its real tiny-scale parity contract, so
+    it runs in the CI bench step, not here.)"""
+    status, breakdown = bench.bench_decode_speculative_ab()
     assert status["dry_run"] == "ok"
     assert status["out_leaves"] > 0
     assert breakdown == {}

@@ -173,6 +173,18 @@ def test_main_emits_json_and_exits_zero_despite_failed_metrics(
                              "fixed_tokens_per_sec_b64": 49_000.0,
                              "users_per_chip_at_fixed_hbm_x_b64": 2.1}))
     monkeypatch.setattr(
+        bench, "bench_decode_speculative_ab",
+        lambda **kw: (1.15, {"spec_g0_b8_tokens_per_sec": 50_000.0,
+                             "spec_g4_b8_tokens_per_sec": 57_500.0,
+                             "acceptance_rate_g4_b8": 0.31,
+                             "spec_selfdraft_g8_b8_tokens_per_sec":
+                                 120_000.0}))
+    monkeypatch.setattr(
+        bench, "bench_decode_speculative_personalized",
+        lambda **kw: (0.9, {"personalized_g0_tokens_per_sec": 48_000.0,
+                            "personalized_g4_tokens_per_sec": 43_200.0,
+                            "base_drafter_acceptance_rate": 0.55}))
+    monkeypatch.setattr(
         bench, "bench_personalized_admission",
         lambda **kw: {"admission_delta_apply_ms": 1.5,
                       "eviction_restore_ms": 1.7, "prefill_ms": 30.0,
@@ -204,6 +216,8 @@ def test_main_emits_json_and_exits_zero_despite_failed_metrics(
     assert "gpt2_fetchsgd_per_worker_sketch_ab" in metrics
     assert "client_store_sketched_codec" in metrics
     assert "gpt2_decode_paged_tokens_per_sec_ab" in metrics
+    assert "gpt2_decode_speculative_tokens_per_sec_ab" in metrics
+    assert "gpt2_decode_speculative_personalized_ab" in metrics
     assert "serve_personalized_admission_overhead" in metrics
     # the dead metrics are absent from the numbers but present in errors
     assert "gpt2_fetchsgd_sketch_rounds_per_sec" not in metrics

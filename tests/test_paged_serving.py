@@ -25,27 +25,18 @@ import numpy as np
 import pytest
 
 from commefficient_tpu.data.tokenizer import ByteTokenizer
-from commefficient_tpu.models.gpt2 import GPT2Config, GPT2DoubleHeads
 from commefficient_tpu.serving import (ContinuousBatchingServer,
-                                       DecodeEngine, PagedKVCache,
+                                       PagedKVCache,
                                        PersonalizationIndex)
 
 
 @pytest.fixture(scope="module")
-def tiny():
-    # ONE engine for the whole module: every test drives the same jit
-    # caches, so prefill/pack/step compile once per shape for the file
+def tiny(serving_tiny_engine):
+    # ONE engine shared with test_speculative (conftest session
+    # fixture): every test drives the same jit caches, so
+    # prefill/pack/step compile once per shape for the whole suite
     # (the parity test runs first and owns the exact-count asserts)
-    tok = ByteTokenizer()
-    cfg = GPT2Config.tiny(vocab_size=tok.vocab_size)
-    model = GPT2DoubleHeads(cfg)
-    ids = np.zeros((1, 1, 8), np.int32)
-    params = model.init(jax.random.PRNGKey(0), ids, ids,
-                        np.zeros((1, 1), np.int32), train=False)["params"]
-    eos = tok.convert_tokens_to_ids("<eos>")
-    engine = DecodeEngine(model, params, eos_id=eos, max_len=48,
-                          method="greedy")
-    return tok, model, params, engine
+    return serving_tiny_engine
 
 
 def _engine_and_prompts(tiny, n=3):
