@@ -1239,6 +1239,138 @@ def bench_decode_paged_ab(batches=(8, 64), prompt_len=128, new_tokens=64,
     return round(ratio, 4), breakdown
 
 
+def bench_decode_paged_quant_ab(batches=(8, 64), prompt_len=128,
+                                new_tokens=64, page_size=16,
+                                requests_per_slot=3, kv_quant="int8"):
+    """Quantized-vs-f32 paged pool A/B: the continuous-batching server
+    run over the same request stream with ``--kv_quant int8`` (int8
+    pools + per-page-per-head f32 scales, ops/kv_quant.py) and
+    ``--kv_quant none`` (the f32 incumbent). Throughput should be ~flat
+    — the dequant runs only on GATHERED pages inside the attention
+    kernel, never on the pool — so the number that matters is the
+    CAPACITY multiplier: the same KV HBM holds ~3.97x the pages at int8
+    (pool bytes + scale bytes vs f32 pool bytes), which multiplies
+    straight onto the paged users-per-chip lever. Replies are not
+    compared here (the int8 logit-tolerance/token-agreement contract is
+    tests/test_serving_kv_quant.py's job; the decode_paged_quant audit pins the
+    no-f32-pool invariant).
+
+    Dry-run traces the int8 paged step and runs the REAL audit rule
+    over its jaxpr — no f32 aval of the pool's (num_pages, page_size,
+    H, hd) shape anywhere — and asserts the byte-accounted capacity
+    multiplier clears 3x.
+
+    Returns (int8/f32 tokens/s ratio at the largest batch, breakdown
+    with both arms' tokens/s and the capacity multiplier)."""
+    import jax
+    import jax.numpy as jnp
+
+    from commefficient_tpu.models.gpt2 import GPT2Config, GPT2DoubleHeads
+    from commefficient_tpu.ops import kv_quant as kvq
+    from commefficient_tpu.serving import (ContinuousBatchingServer,
+                                           DecodeEngine)
+    from commefficient_tpu.serving.paged_cache import PagedKVCache
+
+    P, N = prompt_len, new_tokens
+    S = P + N
+    gcfg = GPT2Config.small(vocab_size=50262)
+    gcfg.n_positions = max(gcfg.n_positions, S)
+    gcfg.dropout = 0.0
+    gcfg.dtype = "bfloat16"
+    model = GPT2DoubleHeads(gcfg)
+    hd = gcfg.n_embd // gcfg.n_head
+    rng = np.random.RandomState(0)
+    key = jax.random.PRNGKey(0)
+    sample_in = (jnp.zeros((1, 1, 8), jnp.int32),
+                 jnp.zeros((1, 1, 8), jnp.int32),
+                 jnp.zeros((1, 1), jnp.int32))
+
+    if DRY_RUN:
+        from commefficient_tpu.analysis import (FootprintRule, ShapePattern,
+                                                walk)
+        B = batches[0]
+        params = jax.eval_shape(
+            lambda r: model.init(r, *sample_in, train=False), key)["params"]
+        engine = DecodeEngine(model, params, eos_id=50261, max_len=S,
+                              method="greedy")
+        pager = PagedKVCache(slots=B, max_len=S, prefill_len=P,
+                             page_size=page_size)
+        pools = jax.eval_shape(
+            lambda: engine.init_paged_pools(pager.num_pages, page_size,
+                                            kv_quant=kv_quant))
+        vec = jax.ShapeDtypeStruct((B,), jnp.int32)
+        closed = jax.make_jaxpr(engine._paged_step_raw)(
+            params, pools,
+            jax.ShapeDtypeStruct((B, pager.max_pages), jnp.int32),
+            vec, vec, vec, key, jax.ShapeDtypeStruct((B,), jnp.bool_))
+        sites, stats = walk(closed)
+        pat = ShapePattern(("num_pages", "page_size", "H", "hd"),
+                           label="f32 materialization of the quantized "
+                                 "KV pool",
+                           allow_primitives=frozenset(), dtype="float32")
+        rep = FootprintRule((pat,)).check(
+            sites, stats, {"num_pages": pager.num_pages,
+                           "page_size": page_size,
+                           "H": gcfg.n_head, "hd": hd})
+        assert rep.ok, [str(v) for v in rep.violations]
+        mult = kvq.capacity_multiplier_vs_f32(pager.num_pages, page_size,
+                                              gcfg.n_head, hd,
+                                              gcfg.n_layer, kv_quant)
+        assert mult >= 3.0, f"capacity multiplier {mult} < 3x"
+        return {"dry_run": "ok",
+                "users_per_chip_at_fixed_hbm_x": round(mult, 4)}, {}
+
+    params = model.init(key, *sample_in, train=False)["params"]
+    engine = DecodeEngine(model, params, eos_id=50261, max_len=S,
+                          method="greedy")
+    breakdown = {"prompt_len": P, "new_tokens": N, "page_size": page_size,
+                 "kv_quant": kv_quant,
+                 "requests_per_slot": requests_per_slot}
+    ratio = None
+    for B in batches:
+        reqs = []
+        for _ in range(requests_per_slot * B):
+            L = int(rng.randint(P // 2, P + 1))
+            reqs.append((rng.randint(0, 50000, L).astype(np.int32).tolist(),
+                         [1] * L))
+        for mode in ("none", kv_quant):
+            tag = "f32" if mode == "none" else mode
+
+            def make():
+                return ContinuousBatchingServer(engine, slots=B,
+                                                prefill_len=P,
+                                                kv_cache="paged",
+                                                page_size=page_size,
+                                                kv_quant=mode)
+
+            warm = make()                       # compile all programs
+            warm.submit(reqs[0][0], reqs[0][1], 1, 2)
+            warm.run()
+            srv = make()
+            for ids, types in reqs:
+                srv.submit(ids, types, 1, N)
+            got, peak = 0, 0
+            t0 = time.perf_counter()
+            while srv._queue or any(r is not None for r in srv._slot_req):
+                for _, toks in srv.step():
+                    got += len(toks)
+                peak = max(peak, srv.pager.pages_in_use)
+            dt = time.perf_counter() - t0
+            breakdown[f"{tag}_tokens_per_sec_b{B}"] = round(got / dt, 1)
+            st = srv.stats()
+            breakdown[f"{tag}_pool_bytes"] = st["kv_pool_bytes"]
+            if mode != "none":
+                # pool-byte capacity multiplier composed onto the paged
+                # peak-vs-reserved ratio: users the same KV HBM holds
+                mult = st["kv_capacity_multiplier_vs_f32"]
+                breakdown["kv_capacity_multiplier_vs_f32"] = round(mult, 4)
+                breakdown[f"users_per_chip_at_fixed_hbm_x_b{B}"] = round(
+                    mult * B * srv.pager.max_pages / max(peak, 1), 2)
+        ratio = (breakdown[f"{kv_quant}_tokens_per_sec_b{B}"]
+                 / breakdown[f"f32_tokens_per_sec_b{B}"])
+    return round(ratio, 4), breakdown
+
+
 def bench_personalized_admission(n_users=16, k=256, prompt_len=128):
     """--serve_personalized admission overhead: applying a user's O(k)
     sparse weight delta at slot admission (PersonalizationIndex.admit)
@@ -1354,7 +1486,7 @@ def bench_personalized_admission(n_users=16, k=256, prompt_len=128):
 
 def bench_decode_speculative_ab(gammas=(0, 2, 4, 8), batches=(1, 8),
                                 prompt_len=128, new_tokens=64,
-                                page_size=16):
+                                page_size=16, method="greedy"):
     """Speculative decoding A/B over the paged serving stack: the
     continuous-batching server run over the same greedy request stream
     with ``speculate_k`` swept over γ ∈ ``gammas`` (γ=0 is the
@@ -1371,9 +1503,18 @@ def bench_decode_speculative_ab(gammas=(0, 2, 4, 8), batches=(1, 8),
     bitwise the non-speculative stream by construction
     (tests/test_speculative.py asserts it; this row only times).
 
+    ``method='topk'`` runs the same sweep with STOCHASTIC acceptance
+    (the Leviathan/Chen residual rule, serving/speculative.py): drafts
+    sampled from the drafter's top-k distribution, accept with prob
+    min(1, q/p), resample rejections from the normalized residual — the
+    emitted marginals match the non-speculative top-k stream
+    (tests/test_speculative.py's distribution-equivalence row) rather
+    than being bitwise.
+
     Dry-run traces the draft and paged-verify programs via eval_shape —
     the verify stays paged end to end (the decode_speculative audit pins
-    the no-dense-slab invariant).
+    the no-dense-slab invariant); at ``method='topk'`` it traces the
+    stochastic twins (rng-threaded draft + residual-rule verify).
 
     Returns (best speculative tokens/s over the γ=0 arm at the largest
     batch, breakdown with per-γ tokens/s + acceptance rates)."""
@@ -1413,7 +1554,7 @@ def bench_decode_speculative_ab(gammas=(0, 2, 4, 8), batches=(1, 8),
             lambda r: drafter.init(r, *sample_in, train=False),
             key)["params"]
         engine = DecodeEngine(model, params, eos_id=V - 1, max_len=S,
-                              method="greedy")
+                              method=method)
         spec = SpeculativeDecoder(engine, gamma=gamma, slots=B,
                                   drafter_model=drafter,
                                   drafter_params=dparams)
@@ -1423,13 +1564,25 @@ def bench_decode_speculative_ab(gammas=(0, 2, 4, 8), batches=(1, 8),
             lambda: engine.init_paged_pools(pager.num_pages, page_size))
         vec = jax.ShapeDtypeStruct((B,), jnp.int32)
         done = jax.ShapeDtypeStruct((B,), jnp.bool_)
-        _, drafts = jax.eval_shape(spec._draft_raw, dparams, spec.dcache,
-                                   vec, vec, vec, vec, vec)
-        assert drafts.shape == (B, gamma), drafts.shape
-        out = jax.eval_shape(
-            spec._paged_verify_raw, params, pools,
-            jax.ShapeDtypeStruct((B, pager.max_pages), jnp.int32),
-            vec, vec, vec, drafts, done)
+        pt = jax.ShapeDtypeStruct((B, pager.max_pages), jnp.int32)
+        if method == "topk":
+            assert spec.stochastic
+            _, drafts, dprobs, _ = jax.eval_shape(
+                spec._draft_stoch_raw, dparams, spec.dcache,
+                vec, vec, vec, vec, vec, key)
+            assert drafts.shape == (B, gamma), drafts.shape
+            assert dprobs.shape == (B, gamma, V), dprobs.shape
+            out = jax.eval_shape(
+                spec._paged_verify_stoch_raw, params, pools, pt,
+                vec, vec, vec, drafts, dprobs, done, key)
+        else:
+            _, drafts = jax.eval_shape(spec._draft_raw, dparams,
+                                       spec.dcache, vec, vec, vec, vec,
+                                       vec)
+            assert drafts.shape == (B, gamma), drafts.shape
+            out = jax.eval_shape(
+                spec._paged_verify_raw, params, pools, pt,
+                vec, vec, vec, drafts, done)
         assert out[1].shape == (B, gamma + 1), out[1].shape  # emitted
         return {"dry_run": "ok",
                 "out_leaves": len(jax.tree.leaves(out))}, {}
@@ -1438,9 +1591,9 @@ def bench_decode_speculative_ab(gammas=(0, 2, 4, 8), batches=(1, 8),
     dparams = drafter.init(jax.random.PRNGKey(1), *sample_in,
                            train=False)["params"]
     engine = DecodeEngine(model, params, eos_id=V - 1, max_len=S,
-                          method="greedy")
+                          method=method)
     breakdown = {"prompt_len": P, "new_tokens": N, "page_size": page_size,
-                 "drafter": "tiny-random",
+                 "drafter": "tiny-random", "method": method,
                  "gammas": list(gammas), "batches": list(batches)}
     ratio = None
     for B in batches:
@@ -1840,13 +1993,29 @@ def _bench_rows():
          lambda: bench_generate(batch=64)),
         ("gpt2_decode_paged_tokens_per_sec_ab",
          lambda: bench_decode_paged_ab()),
+        ("gpt2_decode_paged_quant_ab",
+         lambda: bench_decode_paged_quant_ab()),
         ("gpt2_decode_speculative_tokens_per_sec_ab",
          lambda: bench_decode_speculative_ab()),
+        ("gpt2_decode_speculative_topk_stochastic_ab",
+         lambda: bench_decode_speculative_ab(gammas=(0, 4), batches=(8,),
+                                             method="topk")),
         ("gpt2_decode_speculative_personalized_ab",
          lambda: bench_decode_speculative_personalized()),
         ("serve_personalized_admission_overhead",
          lambda: bench_personalized_admission()),
     ]
+
+
+#: ``--rows`` preset aliases: one name that expands to a curated
+#: selector set. ``serving_column`` is the whole serving-stack column —
+#: paged, quantized-paged, speculative (greedy + stochastic),
+#: personalized — the rows docs/ROOFLINE.md's serving table reads from.
+ROW_PRESETS = {
+    "serving_column": ("gpt2_decode_tokens_per_sec_chip_*",
+                       "*decode_paged*", "*speculative*",
+                       "*personalized*"),
+}
 
 
 def _dry_run_main(row_filter=""):
@@ -1856,7 +2025,8 @@ def _dry_run_main(row_filter=""):
     import fnmatch
     global DRY_RUN
     DRY_RUN = True
-    sel = [s for s in row_filter.split(",") if s]
+    sel = [x for s in row_filter.split(",") if s
+           for x in (ROW_PRESETS.get(s, (s,)))]
 
     def matches(name, s):
         # glob selectors ('*bucket*') when the pattern asks for them,
@@ -1896,7 +2066,8 @@ def main():
                          "programs (jax.eval_shape) without compiling or "
                          "timing; exits nonzero if any row fails to trace")
     ap.add_argument("--rows", action="append", default=None,
-                    help="row selector (substring or glob); repeatable "
+                    help="row selector (substring, glob, or a preset "
+                         "alias like 'serving_column'); repeatable "
                          "and/or comma-separated (--dry-run only)")
     args = ap.parse_args()
 
@@ -2082,6 +2253,18 @@ def main():
                     "design — the users_per_chip_at_fixed_hbm_x entries "
                     "are the capacity win (ROADMAP item 1)"})
         if paged_ab is not None else None)
+    quant_ab = res["gpt2_decode_paged_quant_ab"]
+    add("gpt2_decode_paged_quant_ab",
+        round(quant_ab[0], 4) if quant_ab is not None else None,
+        "speedup_x",
+        dict(quant_ab[1], **{
+            "note": "--kv_quant int8 vs none on the paged server, same "
+                    "request stream; throughput ~flat by design (dequant "
+                    "only on gathered pages, the pool stays int8 — the "
+                    "decode_paged_quant audit pins it), the "
+                    "kv_capacity_multiplier_vs_f32 and "
+                    "users_per_chip_at_fixed_hbm_x entries are the win"})
+        if quant_ab is not None else None)
     spec_ab = res["gpt2_decode_speculative_tokens_per_sec_ab"]
     add("gpt2_decode_speculative_tokens_per_sec_ab",
         round(spec_ab[0], 4) if spec_ab is not None else None,
@@ -2095,6 +2278,18 @@ def main():
                     "selfdraft arm is the ceiling; refutation at any γ "
                     "is the measured answer"})
         if spec_ab is not None else None)
+    spec_topk = res["gpt2_decode_speculative_topk_stochastic_ab"]
+    add("gpt2_decode_speculative_topk_stochastic_ab",
+        round(spec_topk[0], 4) if spec_topk is not None else None,
+        "speedup_x",
+        dict(spec_topk[1], **{
+            "note": "--speculate_k + --serve_sample topk: stochastic "
+                    "acceptance (accept w.p. min(1, q/p), residual "
+                    "resample) over the paged server vs the "
+                    "non-speculative topk stream — marginals match by "
+                    "the residual-rule theorem "
+                    "(tests/test_speculative.py), this row only times"})
+        if spec_topk is not None else None)
     spec_pers = res["gpt2_decode_speculative_personalized_ab"]
     add("gpt2_decode_speculative_personalized_ab",
         round(spec_pers[0], 4) if spec_pers is not None else None,

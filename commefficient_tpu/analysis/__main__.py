@@ -26,8 +26,8 @@ def main(argv=None) -> int:
                         choices=["round", "round_bucketed", "sketch_batched",
                                  "buffered", "client_store", "gpt2",
                                  "attention", "sketch", "decode",
-                                 "decode_paged", "decode_speculative",
-                                 "all"])
+                                 "decode_paged", "decode_paged_quant",
+                                 "decode_speculative", "all"])
     parser.add_argument("--no-retrace", action="store_true",
                         help="skip the (compile-heavy) retrace guards")
     parser.add_argument("--prng-lint", action="store_true",

@@ -76,6 +76,12 @@ class ShapePattern:
     allow_primitives: frozenset = frozenset()
     #: both orientations for rank-2 patterns (default True).
     match_transpose: bool = True
+    #: restrict the ban to avals of this dtype (string form, e.g.
+    #: "float32"); None bans the shape at any dtype. Needed when a
+    #: LEGAL array shares the forbidden shape at another dtype — the
+    #: quantized KV pools are exactly pool-shaped int8, and only their
+    #: f32 materialization is the bug (decode_paged_quant target).
+    dtype: Optional[str] = None
 
     def concretize(self, bindings: dict) -> list:
         shape = []
@@ -93,7 +99,8 @@ class ShapePattern:
 
     def describe(self) -> str:
         sym = "(" + ", ".join(str(d) for d in self.dims) + ")"
-        return f"{self.label or 'forbidden'} {sym}"
+        dt = f" [{self.dtype}]" if self.dtype else ""
+        return f"{self.label or 'forbidden'} {sym}{dt}"
 
 
 #: The repo's standing memory contracts (docs/ROOFLINE.md, PR 2/3):
@@ -139,6 +146,9 @@ class FootprintRule:
                     continue
                 shape = tuple(aval.shape)
                 for pat, shapes in active:
+                    if pat.dtype is not None and \
+                            str(getattr(aval, "dtype", "?")) != pat.dtype:
+                        continue
                     if shape in shapes and \
                             site.primitive not in pat.allow_primitives:
                         report.ok = False

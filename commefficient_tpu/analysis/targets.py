@@ -835,6 +835,92 @@ def decode_speculative_target(mutate: bool = False) -> AuditTarget:
         retrace=retrace)
 
 
+def decode_paged_quant_target(mutate: bool = False) -> AuditTarget:
+    """The quantized paged decode step (ops/kv_quant.py codec +
+    ``paged_step`` over int8 pools).
+
+    The quantization contract is that the KV pools live in HBM at the
+    CODEC dtype — ``(num_pages, page_size, H, hd)`` int8 plus
+    ``(num_pages, H)`` f32 scale rows — and dequantization happens only
+    on GATHERED pages inside the attention kernel (the 5-D
+    ``(B, M, P, H, D)`` working set), never on the pool itself.  So a
+    FLOAT32 aval of the pool's shape anywhere in the step is the codec
+    silently round-tripping the whole pool through f32 — the exact HBM
+    reservation quantization exists to remove.  The ban is dtype-scoped
+    because the pool shape itself is legal at int8: the requant-on-write
+    scatters produce pool-shaped int8 outputs by design.  The retrace
+    guard drives the step through a REAL int8 paged server (admissions,
+    requant writes, page-boundary crossings) and asserts the compile
+    cache stays flat.
+
+    ``mutate=True`` traces the UNQUANTIZED paged step at the same dims —
+    whose f32 pool-shaped write-back scatters are exactly the aval the
+    rule bans — proving the dtype-scoped gate is live
+    (tests/test_serving_kv_quant.py pins this).
+    """
+    engine, S = _decode_engine()
+    B = 3
+    cfg = engine.model.config
+    page_size = 8
+    tok = jnp.asarray(np.full((B,), 5, np.int32))
+    typ = jnp.asarray(np.full((B,), 7, np.int32))
+    pos = jnp.asarray(np.array([3, 9, 1], np.int32))
+    rng0 = jax.random.PRNGKey(2)
+    done = jnp.zeros((B,), bool)
+    max_pages = S // page_size
+    num_pages = 1 + B * max_pages
+
+    def trace():
+        mode = "none" if mutate else "int8"
+        pools = engine.init_paged_pools(num_pages, page_size,
+                                        kv_quant=mode)
+        pt = jnp.zeros((B, max_pages), jnp.int32)
+        return jax.make_jaxpr(engine._paged_step_raw)(
+            engine.params, pools, pt, tok, typ, pos, rng0, done)
+
+    def retrace():
+        from commefficient_tpu.serving import ContinuousBatchingServer
+        srv = ContinuousBatchingServer(engine, slots=B, prefill_len=16,
+                                       kv_cache="paged",
+                                       page_size=page_size,
+                                       kv_quant="int8")
+        rs = np.random.RandomState(41)
+        V = cfg.vocab_size
+        shared = [int(t) for t in rs.randint(0, V - 1, 16)]
+
+        def drive(i):
+            if len(srv._queue) < 2:
+                # same churn as decode_paged — shared-prefix sharers +
+                # a private prompt — but every write requantizes pages
+                srv.submit(shared, [7] * 16, 7, 5)
+                srv.submit(shared, [7] * 16, 7, 3)
+                pl = int(rs.randint(3, 12))
+                srv.submit([int(t) for t in rs.randint(0, V - 1, pl)],
+                           [7] * pl, 7, 4)
+            srv.step()
+
+        return check_retrace(engine.paged_step, None, repeats=3,
+                             warmup=1, drive=drive)
+
+    f32pool = ShapePattern(("num_pages", "page_size", "H", "hd"),
+                           label="f32 materialization of the quantized "
+                                 "KV pool",
+                           allow_primitives=frozenset(),
+                           dtype="float32")
+    return AuditTarget(
+        name="decode_paged_quant/step" + ("(mutated)" if mutate else ""),
+        description="int8-paged decode step; pool stays codec-dtype, "
+                    "dequant only on gathered pages — strict ban on any "
+                    "f32 aval of the pool shape"
+                    + (" [unquantized-pool mutation — must fail]"
+                       if mutate else ""),
+        trace=trace,
+        dims={"num_pages": num_pages, "page_size": page_size,
+              "H": cfg.n_head, "hd": cfg.n_embd // cfg.n_head},
+        rules=(FootprintRule((f32pool,)), TransferRule()),
+        retrace=retrace)
+
+
 # --------------------------------------------------------------------------
 # sketch ops
 # --------------------------------------------------------------------------
@@ -905,6 +991,8 @@ def build_targets(name: str) -> list:
         return [decode_paged_target()]
     if name == "decode_speculative":
         return [decode_speculative_target()]
+    if name == "decode_paged_quant":
+        return [decode_paged_quant_target()]
     if name == "client_store":
         return [client_store_target()]
     if name == "all":
@@ -914,7 +1002,9 @@ def build_targets(name: str) -> list:
                 + build_targets("gpt2") + build_targets("attention")
                 + build_targets("sketch") + build_targets("decode")
                 + build_targets("decode_paged")
-                + build_targets("decode_speculative"))
+                + build_targets("decode_speculative")
+                + build_targets("decode_paged_quant"))
     raise ValueError(f"unknown audit target {name!r} (round|round_bucketed|"
                      f"sketch_batched|buffered|client_store|gpt2|attention|"
-                     f"sketch|decode|decode_paged|decode_speculative|all)")
+                     f"sketch|decode|decode_paged|decode_speculative|"
+                     f"decode_paged_quant|all)")

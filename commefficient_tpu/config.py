@@ -120,19 +120,30 @@ class FedConfig:
     # personalization_from_checkpoint refuses a mismatch at load.
     serve_personalized: bool = False
     # Serving-time sampling method for the decode engine ('greedy' or
-    # 'topk'). Greedy is the default and the only method speculative
-    # decoding composes with (see speculate_k).
+    # 'topk'). Both compose with speculate_k: greedy speculation uses
+    # argmax-prefix acceptance, topk uses the stochastic residual rule
+    # (serving/speculative.py).
     serve_sample: str = "greedy"
     # Speculative decoding over the serving stack
     # (serving/speculative.py): a small drafter proposes speculate_k
     # tokens per slot and ONE multi-token target forward verifies all
-    # speculate_k+1 positions, accepting the longest matching prefix
-    # plus one corrected token — emitted tokens bitwise-identical to
-    # non-speculative greedy decode. 0 disables. Composes with
+    # speculate_k+1 positions. Under serve_sample='greedy' acceptance
+    # keeps the longest argmax-matching prefix plus one corrected token
+    # — emitted tokens bitwise-identical to non-speculative greedy
+    # decode; under 'topk' the stochastic accept/resample rule
+    # (Leviathan/Chen) makes the emitted marginals exactly the
+    # non-speculative topk distribution. 0 disables. Composes with
     # kv_cache='paged' and serve_personalized (the base-weights drafter
     # is free: the per-user delta is O(k), so draft with base, verify
     # with base + delta).
     speculate_k: int = 0
+    # KV page-pool codec for kv_cache='paged' (ops/kv_quant.py):
+    # 'none' keeps f32/compute-dtype pools and bitwise greedy parity;
+    # 'int8' stores pages as int8 with per-page-per-head f32 scales
+    # (~4x pool HBM, toleranced — not bitwise — replies); 'int4' is the
+    # stretch mode (nibble-packed, ~8x). Quantized pools move
+    # users_per_chip_at_fixed_hbm_x (ROADMAP item 3).
+    kv_quant: str = "none"
     # Offload pipeline depth (api.HostOffloadPipeline): how many rounds of
     # output rows may sit in the lazy-writeback queue while their (W, d)
     # device buffers stay alive. 2 = double buffering (gather round t+1 /
@@ -254,13 +265,10 @@ class FedConfig:
                 f"--speculate_k must be >= 0, got {self.speculate_k}: "
                 f"use a draft length >= 1 to speculate, or 0 to serve "
                 f"non-speculatively")
-        if self.speculate_k and self.serve_sample == "topk":
+        if self.kv_quant not in ("none", "int8", "int4"):
             raise ValueError(
-                "--speculate_k uses greedy acceptance (the drafter's "
-                "argmax stream is verified against the target's), which "
-                "requires serve_sample='greedy'; topk sampling would "
-                "need the stochastic accept/resample rule — drop "
-                "--speculate_k or drop --serve_sample topk")
+                f"--kv_quant must be 'none', 'int8' or 'int4', got "
+                f"{self.kv_quant!r}")
         if self.client_state == "sketched":
             if self.error_type != "local":
                 raise ValueError(

@@ -190,14 +190,33 @@ class CausalSelfAttention(nn.Module):
                 pc = jnp.minimum(p, M * Pg - 1)
                 phys = jnp.where(in_range, cache["pt"][b, pc // Pg], 0)
                 off = pc % Pg
-                ck = cache["k"].at[phys, off].set(
-                    k.astype(cache["k"].dtype))
-                cv = cache["v"].at[phys, off].set(
-                    v.astype(cache["v"].dtype))
-                y = paged_verify_attention(q, ck, cv, cache["pt"],
-                                           jnp.minimum(position,
-                                                       M * Pg - 1))
-                new_cache = {"k": ck, "v": cv, "pt": cache["pt"]}
+                if "k_scale" in cache:
+                    # quantized pools (ops/kv_quant.py): requant-on-write
+                    # into the frontier pages, scales riding the cache;
+                    # attention dequantizes in-gather so no f32 array of
+                    # the pool's shape appears (decode_paged_quant audit)
+                    from commefficient_tpu.ops import kv_quant
+                    mode = kv_quant.infer_mode(cache["k"],
+                                               C // self.n_head)
+                    ck, ks = kv_quant.insert_tokens(
+                        cache["k"], cache["k_scale"], k, phys, off, mode)
+                    cv, vs = kv_quant.insert_tokens(
+                        cache["v"], cache["v_scale"], v, phys, off, mode)
+                    y = paged_verify_attention(
+                        q, ck, cv, cache["pt"],
+                        jnp.minimum(position, M * Pg - 1),
+                        k_scale=ks, v_scale=vs)
+                    new_cache = {"k": ck, "v": cv, "k_scale": ks,
+                                 "v_scale": vs, "pt": cache["pt"]}
+                else:
+                    ck = cache["k"].at[phys, off].set(
+                        k.astype(cache["k"].dtype))
+                    cv = cache["v"].at[phys, off].set(
+                        v.astype(cache["v"].dtype))
+                    y = paged_verify_attention(q, ck, cv, cache["pt"],
+                                               jnp.minimum(position,
+                                                           M * Pg - 1))
+                    new_cache = {"k": ck, "v": cv, "pt": cache["pt"]}
             elif verify and T > 1:
                 # dense-slab verify twin: scatter T rows at per-row
                 # positions with mode="drop" (out-of-capacity writes

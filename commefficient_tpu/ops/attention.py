@@ -133,7 +133,10 @@ def decode_attention(q, k, v, q_pos, *,
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
-def paged_verify_attention(q, k_pool, v_pool, page_table, q_pos) -> jax.Array:
+def paged_verify_attention(q, k_pool, v_pool, page_table, q_pos, *,
+                           k_scale: Optional[jax.Array] = None,
+                           v_scale: Optional[jax.Array] = None
+                           ) -> jax.Array:
     """Multi-query attention against a block-paged KV cache.
 
     ``q`` is (B, Tq, H, D) with small static Tq — 1 for token-by-token
@@ -153,6 +156,15 @@ def paged_verify_attention(q, k_pool, v_pool, page_table, q_pos) -> jax.Array:
     above the row's position) and keeps rejected speculative entries
     above a row's accepted frontier unattendable until overwritten.
 
+    With ``k_scale``/``v_scale`` ((num_pages, H) f32) the pools are
+    QUANTIZED (ops/kv_quant.py: int8, or nibble-packed int4) and the
+    dequantization happens here, on the GATHERED pages only — the
+    per-page scales gather through the same page table and multiply
+    the (B, M, P, H, D) working set, so no f32 (or compute-dtype)
+    array of the pool's own (num_pages, page_size, H, D) shape ever
+    exists, which is exactly what the ``decode_paged_quant`` audit
+    target forbids.
+
     The gathered pages stay 5-D (B, M, P, H, D) end to end — they are
     never reshaped to a (B, S, H, D) slab, so the per-step working set
     is the gather plus (B, H, Tq, M, P) scores and the ``decode_paged``
@@ -165,6 +177,13 @@ def paged_verify_attention(q, k_pool, v_pool, page_table, q_pos) -> jax.Array:
     M = page_table.shape[1]
     k = k_pool[page_table]                                 # (B, M, P, H, D)
     v = v_pool[page_table]
+    if k_scale is not None:
+        from commefficient_tpu.ops import kv_quant
+        mode = kv_quant.infer_mode(k_pool, D)
+        k = kv_quant.dequantize_pages(k, k_scale[page_table],
+                                      mode).astype(q.dtype)
+        v = kv_quant.dequantize_pages(v, v_scale[page_table],
+                                      mode).astype(q.dtype)
     s = jnp.einsum("bqhd,bmphd->bhqmp", q, k,
                    preferred_element_type=jnp.float32) / np.sqrt(D)
     logical = jnp.arange(M)[:, None] * P + jnp.arange(P)[None, :]  # (M, P)
@@ -177,13 +196,18 @@ def paged_verify_attention(q, k_pool, v_pool, page_table, q_pos) -> jax.Array:
     return jnp.einsum("bhqmp,bmphd->bqhd", p, v)
 
 
-def paged_decode_attention(q, k_pool, v_pool, page_table, q_pos) -> jax.Array:
+def paged_decode_attention(q, k_pool, v_pool, page_table, q_pos, *,
+                           k_scale: Optional[jax.Array] = None,
+                           v_scale: Optional[jax.Array] = None
+                           ) -> jax.Array:
     """Single-query (Tq == 1) decode against the paged cache — a pure
     delegation to ``paged_verify_attention``, which is the same math at
     general Tq (identical einsums, so the Tq=1 trace is bitwise the
     pre-speculative program). Kept as the named decode entry point the
-    serving step and its docs refer to."""
-    return paged_verify_attention(q, k_pool, v_pool, page_table, q_pos)
+    serving step and its docs refer to. ``k_scale``/``v_scale`` select
+    the quantized-pool form (in-gather dequant; ops/kv_quant.py)."""
+    return paged_verify_attention(q, k_pool, v_pool, page_table, q_pos,
+                                  k_scale=k_scale, v_scale=v_scale)
 
 
 def _fold_block(acc, q, kb, vb, q_pos, k_pos, kv_mask_b, causal):

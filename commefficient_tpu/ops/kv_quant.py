@@ -1,0 +1,207 @@
+"""Per-page KV quantization codec for the block-paged serving cache.
+
+The paged pools (serving/paged_cache.py) store every cached token as
+f32/bf16, so HBM — not compute — caps concurrent users per chip
+(ROADMAP item 3; ``users_per_chip_at_fixed_hbm_x`` is the number this
+moves). This module is the codec the pools store instead:
+
+* ``int8`` — each (page, head) tile of ``page_size * head_dim`` values
+  is scaled by ``amax / 127`` into int8. Pool bytes drop 4x vs f32;
+  the per-page-per-head f32 scale array adds ``1 / (page_size *
+  head_dim)`` overhead (≈0.2% at the default 16x64 tile).
+* ``int4`` — stretch mode behind the same interface: ``amax / 7``
+  scaling, two values packed per byte along the head_dim axis
+  (offset-binary nibbles, so unpacking needs no sign extension).
+  head_dim must be even.
+
+The scale is per (physical page, head): one f32 per (num_pages, H)
+entry, amax taken over the page's (page_size, head_dim) tile. That
+granularity keeps the codec a pure per-page transform — copy-on-write
+prefix sharing (PagedKVCache) shares a quantized page by sharing its
+pool row AND its scale row, with no cross-page state.
+
+Quantization happens at WRITE time (DecodeEngine's paged insert pack,
+the decode/verify frontier scatter in models/gpt2.py) and
+dequantization happens INSIDE the paged attention gather
+(ops/attention.paged_verify_attention): only the gathered (B, M, P, H,
+D) working set is ever dequantized, never the pool, so no f32 array of
+the pool's (num_pages, page_size, H, head_dim) shape exists anywhere
+in the step program — the ``decode_paged_quant`` graft-audit target
+(analysis/targets.py) forbids exactly that aval.
+
+Frontier writes REQUANTIZE: inserting a token into a page gathers the
+quantized page, dequantizes, writes the new token's values, recomputes
+the scale and scatters page + scale back. When the scale is unchanged
+the round-trip is idempotent (round(q * s / s) == q); when a new token
+grows the amax, previously stored values requantize under the larger
+scale and absorb at most half an lsb of additional error — bounded by
+the serving tolerance contract (tests/test_serving_kv_quant.py). Multi-token
+verify windows insert SEQUENTIALLY (a statically unrolled loop over
+the window) because consecutive tokens usually land in the SAME page:
+independent per-token scatters would collide with undefined ordering.
+
+An all-zero page (amax 0) stores scale 0 and quantizes through a safe
+divisor, so dequantization reproduces exact zeros — never NaN.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+#: accepted --kv_quant modes ("none" keeps the f32/bf16 pools)
+KV_QUANT_MODES = ("none", "int8", "int4")
+
+_QMAX = {"int8": 127.0, "int4": 7.0}
+
+
+def validate_mode(mode: str) -> str:
+    if mode not in KV_QUANT_MODES:
+        raise ValueError(f"kv_quant must be one of {KV_QUANT_MODES}, "
+                         f"got {mode!r}")
+    return mode
+
+
+def pool_dtype(mode: str):
+    """Storage dtype of a quantized pool (int4 packs nibble pairs into
+    uint8 along head_dim, halving that axis)."""
+    validate_mode(mode)
+    if mode == "int8":
+        return jnp.int8
+    if mode == "int4":
+        return jnp.uint8
+    raise ValueError("mode 'none' pools keep the model compute dtype")
+
+
+def packed_head_dim(head_dim: int, mode: str) -> int:
+    """The pool's last-axis size for ``mode`` (head_dim, or head_dim/2
+    for nibble-packed int4)."""
+    if mode == "int4":
+        if head_dim % 2:
+            raise ValueError(f"int4 packs value pairs along head_dim, "
+                             f"which must be even; got {head_dim}")
+        return head_dim // 2
+    return head_dim
+
+
+def infer_mode(pool, head_dim: int) -> str:
+    """Recover the codec mode from a pool's static dtype/shape — the
+    jitted programs carry no mode flag (a string leaf would break the
+    cache pytree), so the trace keys off the arrays themselves."""
+    if pool.dtype == jnp.int8:
+        return "int8"
+    if pool.dtype == jnp.uint8 and pool.shape[-1] == head_dim // 2:
+        return "int4"
+    raise ValueError(f"cannot infer kv_quant mode from pool dtype "
+                     f"{pool.dtype} shape {pool.shape} (head_dim "
+                     f"{head_dim})")
+
+
+# ---- pure page transforms (leading batch dims arbitrary) --------------
+
+
+def _pack_int4(q):
+    """(..., D) int32 nibbles in [-7, 7] -> (..., D/2) uint8
+    offset-binary pairs (value + 8 per nibble, so unpack is a
+    subtraction, never a sign extension)."""
+    n = (q + 8).astype(jnp.uint8)
+    return n[..., 0::2] | (n[..., 1::2] << 4)
+
+
+def _unpack_int4(packed):
+    """(..., D/2) uint8 -> (..., D) int32 nibbles in [-7, 7]."""
+    lo = (packed & 0xF).astype(jnp.int32) - 8
+    hi = (packed >> 4).astype(jnp.int32) - 8
+    return jnp.stack([lo, hi], axis=-1).reshape(packed.shape[:-1]
+                                                + (2 * packed.shape[-1],))
+
+
+def quantize_pages(x, mode: str):
+    """Quantize pages ``x`` (..., page_size, H, head_dim) float ->
+    (quantized pages (..., page_size, H, head_dim[/2]), scales (..., H)
+    f32). Scale is amax over the (page_size, head_dim) tile / qmax; an
+    all-zero tile stores scale 0 and quantizes via a safe divisor so
+    dequantization returns exact zeros."""
+    qmax = _QMAX[mode]
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=(-3, -1))             # (..., H)
+    scale = amax / qmax
+    safe = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(jnp.round(xf / safe[..., None, :, None]),
+                 -qmax, qmax).astype(jnp.int32)
+    if mode == "int4":
+        return _pack_int4(q), scale
+    return q.astype(jnp.int8), scale
+
+
+def dequantize_pages(q, scale, mode: str):
+    """Dequantize pages (..., page_size, H, head_dim[/2]) with scales
+    (..., H) back to f32 (..., page_size, H, head_dim). Callers cast to
+    the compute dtype themselves; this stays f32 so the requant
+    round-trip in ``insert_tokens`` is exact when the scale holds."""
+    if mode == "int4":
+        q = _unpack_int4(q)
+    return q.astype(jnp.float32) * scale[..., None, :, None]
+
+
+def insert_tokens(qpool, scales, vals, phys, off, mode: str):
+    """Requant-on-write: insert per-row token values into quantized
+    pool pages, one verify-window position at a time.
+
+    ``qpool`` (num_pages, page_size, H, Dq) quantized pool, ``scales``
+    (num_pages, H) f32, ``vals`` (B, T, H, head_dim) the new tokens'
+    k or v, ``phys`` (B, T) int32 physical destination pages, ``off``
+    (B, T) int32 in-page offsets. Returns (qpool, scales) updated.
+
+    The loop over T is STATICALLY UNROLLED and sequential: consecutive
+    verify-window tokens usually share a page, and each iteration must
+    read the pool the previous one wrote — independent scatters to the
+    same page would collide with undefined duplicate-index ordering.
+    Within one iteration, rows never share a real page (frontier pages
+    are private per slot); rows routed to the garbage page (done lanes,
+    out-of-capacity writes) can collide there, which is harmless — the
+    garbage page is never attendable (mask by logical position)."""
+    B, T = phys.shape
+    rows = jnp.arange(B)
+    for t in range(T):
+        page = dequantize_pages(qpool[phys[:, t]], scales[phys[:, t]],
+                                mode)                       # (B, P, H, D)
+        page = page.at[rows, off[:, t]].set(
+            vals[:, t].astype(jnp.float32))
+        qpage, nscale = quantize_pages(page, mode)
+        qpool = qpool.at[phys[:, t]].set(qpage)
+        scales = scales.at[phys[:, t]].set(nscale)
+    return qpool, scales
+
+
+# ---- HBM accounting ---------------------------------------------------
+
+
+def pool_bytes(num_pages: int, page_size: int, n_head: int,
+               head_dim: int, n_layer: int, mode: str,
+               base_dtype=np.float32) -> int:
+    """Total KV pool bytes (k + v, all layers) including scale arrays."""
+    validate_mode(mode)
+    per_layer_elems = num_pages * page_size * n_head * head_dim
+    if mode == "none":
+        itemsize = np.dtype(base_dtype).itemsize
+        return 2 * n_layer * per_layer_elems * itemsize
+    elems = num_pages * page_size * n_head * packed_head_dim(head_dim,
+                                                             mode)
+    scale_bytes = num_pages * n_head * 4
+    return 2 * n_layer * (elems + scale_bytes)
+
+
+def capacity_multiplier_vs_f32(num_pages: int, page_size: int,
+                               n_head: int, head_dim: int, n_layer: int,
+                               mode: str) -> float:
+    """How many more users fit in the same HBM vs f32 pools: the pool
+    byte ratio (KV capacity scales linearly with pool bytes at fixed
+    page accounting). 1.0 at mode 'none'; ≈3.97x at int8 with the
+    default 16x64 page tile; ≈7.8x at int4."""
+    f32 = pool_bytes(num_pages, page_size, n_head, head_dim, n_layer,
+                     "none", base_dtype=np.float32)
+    got = pool_bytes(num_pages, page_size, n_head, head_dim, n_layer,
+                     mode)
+    return f32 / got

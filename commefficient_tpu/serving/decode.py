@@ -128,16 +128,37 @@ class DecodeEngine:
         new_pos = jnp.minimum(pos + 1, self.max_len - 1)
         return cache, nxt, new_pos, rng, new_done
 
-    def init_paged_pools(self, num_pages: int, page_size: int):
+    def init_paged_pools(self, num_pages: int, page_size: int,
+                         kv_quant: str = "none"):
         """Zero per-layer KV page pools for the block-paged server
         (serving/paged_cache.py): a tuple with one ``{"k", "v"}`` dict
         per layer, each (num_pages, page_size, n_head, head_dim) in the
-        compute dtype. Physical page 0 is the reserved garbage page."""
+        compute dtype. Physical page 0 is the reserved garbage page.
+
+        ``kv_quant`` in ("int8", "int4") stores the pools quantized
+        (ops/kv_quant.py): the pool dtype becomes int8/packed-uint8 and
+        each layer dict gains per-page-per-head f32 ``k_scale`` /
+        ``v_scale`` arrays ((num_pages, n_head)). Every downstream
+        program (pack, step, verify) dispatches on the presence of the
+        scale keys, so mode 'none' traces byte-identical programs to a
+        build without the codec."""
+        from commefficient_tpu.ops import kv_quant as kvq
+        kvq.validate_mode(kv_quant)
         cfg = self.model.config
+        hd = cfg.n_embd // cfg.n_head
+        if kv_quant == "none":
+            shape = (int(num_pages), int(page_size), cfg.n_head, hd)
+            return tuple({"k": jnp.zeros(shape, cfg.jnp_dtype),
+                          "v": jnp.zeros(shape, cfg.jnp_dtype)}
+                         for _ in range(cfg.n_layer))
         shape = (int(num_pages), int(page_size), cfg.n_head,
-                 cfg.n_embd // cfg.n_head)
-        return tuple({"k": jnp.zeros(shape, cfg.jnp_dtype),
-                      "v": jnp.zeros(shape, cfg.jnp_dtype)}
+                 kvq.packed_head_dim(hd, kv_quant))
+        sshape = (int(num_pages), cfg.n_head)
+        dt = kvq.pool_dtype(kv_quant)
+        return tuple({"k": jnp.zeros(shape, dt),
+                      "v": jnp.zeros(shape, dt),
+                      "k_scale": jnp.zeros(sshape, jnp.float32),
+                      "v_scale": jnp.zeros(sshape, jnp.float32)}
                      for _ in range(cfg.n_layer))
 
     def _paged_step_raw(self, params, pools, pt, tok, type_tok, pos, rng,
@@ -147,12 +168,16 @@ class DecodeEngine:
         is traced — the host rebuilds it between steps (admission,
         eviction, frontier allocation, prefix sharing) without ever
         retracing this program. Token/done/pos semantics are identical
-        to the dense step, so greedy parity is bitwise."""
-        cache = tuple({"k": p["k"], "v": p["v"], "pt": pt} for p in pools)
+        to the dense step, so greedy parity is bitwise. Quantized pools
+        (init_paged_pools(kv_quant=...)) carry their scale arrays in the
+        same dicts; the merge is key-generic so both layouts share this
+        one program body (distinct compiles — the pytree differs)."""
+        cache = tuple({**p, "pt": pt} for p in pools)
         zero = jnp.zeros_like(tok)
         logits, cache = self._apply(params, tok[:, None], type_tok[:, None],
                                     cache, pos, zero)
-        new_pools = tuple({"k": c["k"], "v": c["v"]} for c in cache)
+        new_pools = tuple({k: v for k, v in c.items() if k != "pt"}
+                          for c in cache)
         nxt, rng = sample_next(logits, rng, method=self.method,
                                top_k=self.top_k,
                                temperature=self.temperature)
@@ -169,17 +194,36 @@ class DecodeEngine:
         prefill-window pages beyond the prompt point at the garbage
         page. One compiled program regardless of prompt length or share
         pattern — shared pages are rewritten with bitwise-identical
-        content (causal k/v at position i depend only on tokens <= i)."""
+        content (causal k/v at position i depend only on tokens <= i).
+
+        Quantized pools quantize at pack time (ops/kv_quant.py): pages
+        and their per-page-per-head scales scatter together, so a
+        copy-on-write shared page shares its scale row too. The shared
+        rewrite stays idempotent — identical prompt pages quantize to
+        identical (page, scale) pairs."""
+        from commefficient_tpu.ops import kv_quant as kvq
         n = dst.shape[0]
         out = []
         for pool, row in zip(pools, row_cache):
             P = pool["k"].shape[1]
 
-            def put(pl, r):
-                pages = r[0, :n * P].reshape((n, P) + r.shape[2:])
-                return pl.at[dst].set(pages.astype(pl.dtype))
-            out.append({"k": put(pool["k"], row["k"]),
-                        "v": put(pool["v"], row["v"])})
+            def pages_of(r):
+                return r[0, :n * P].reshape((n, P) + r.shape[2:])
+
+            if "k_scale" in pool:
+                mode = kvq.infer_mode(pool["k"], row["k"].shape[-1])
+                qk, sk = kvq.quantize_pages(pages_of(row["k"]), mode)
+                qv, sv = kvq.quantize_pages(pages_of(row["v"]), mode)
+                out.append({"k": pool["k"].at[dst].set(qk),
+                            "v": pool["v"].at[dst].set(qv),
+                            "k_scale": pool["k_scale"].at[dst].set(sk),
+                            "v_scale": pool["v_scale"].at[dst].set(sv)})
+            else:
+                def put(pl, r):
+                    pages = pages_of(r)
+                    return pl.at[dst].set(pages.astype(pl.dtype))
+                out.append({"k": put(pool["k"], row["k"]),
+                            "v": put(pool["v"], row["v"])})
         return tuple(out)
 
     def _generate_raw(self, params, ids, types, lengths, reply_type, rng,

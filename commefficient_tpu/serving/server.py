@@ -78,17 +78,24 @@ class ContinuousBatchingServer:
                  page_size: int = 16, num_pages: int = None,
                  share_prefix: bool = True, personalize=None,
                  speculate_k: int = 0, drafter_model=None,
-                 drafter_params=None):
+                 drafter_params=None, kv_quant: str = "none"):
+        from commefficient_tpu.ops import kv_quant as kvq
         if prefill_len > engine.max_len:
             raise ValueError(f"prefill_len {prefill_len} exceeds cache "
                              f"capacity {engine.max_len}")
         if kv_cache not in ("fixed", "paged"):
             raise ValueError(f"kv_cache must be 'fixed' or 'paged', "
                              f"got {kv_cache!r}")
+        kvq.validate_mode(kv_quant)
+        if kv_quant != "none" and kv_cache != "paged":
+            raise ValueError("kv_quant is a property of the paged pools "
+                             "(ops/kv_quant.py) — serve with "
+                             "kv_cache='paged' or kv_quant='none'")
         self.engine = engine
         self.slots = int(slots)
         self.prefill_len = int(prefill_len)
         self.kv_cache = kv_cache
+        self.kv_quant = kv_quant
         self.personalize = personalize
         B = self.slots
         if kv_cache == "paged":
@@ -102,7 +109,8 @@ class ContinuousBatchingServer:
                 page_size=page_size, num_pages=num_pages,
                 share_prefix=share_prefix and personalize is None)
             self.cache = engine.init_paged_pools(self.pager.num_pages,
-                                                 page_size)
+                                                 page_size,
+                                                 kv_quant=kv_quant)
         else:
             self.pager = None
             self.cache = engine.init_cache(B)
@@ -303,9 +311,17 @@ class ContinuousBatchingServer:
         """One draft + verify round over the whole slot array: up to
         γ+1 tokens per active slot, same two programs every round."""
         spec, eng = self.spec, self.engine
-        spec.dcache, drafts = spec.draft(
-            spec.dparams, spec.dcache, self.prev_tok, self.prev_typ,
-            self.tok, self.typ, self.pos)
+        if spec.stochastic:
+            # the stochastic draft/verify programs thread the server's
+            # rng (drafter sampling, acceptance uniforms, residual and
+            # bonus draws all come from the one carried key chain)
+            spec.dcache, drafts, dprobs, self.rng = spec.draft(
+                spec.dparams, spec.dcache, self.prev_tok, self.prev_typ,
+                self.tok, self.typ, self.pos, self.rng)
+        else:
+            spec.dcache, drafts = spec.draft(
+                spec.dparams, spec.dcache, self.prev_tok, self.prev_typ,
+                self.tok, self.typ, self.pos)
         if self.pager is not None:
             for slot in active:
                 # pages covering the whole verify window [pos, pos+γ];
@@ -313,10 +329,21 @@ class ContinuousBatchingServer:
                 self.pager.ensure_range(
                     slot, int(self.pager.pos[slot]) + spec.gamma)
             pt = self.pager.device_table()
+            if spec.stochastic:
+                (self.cache, emitted, acc, self.tok, self.prev_tok,
+                 self.pos, self.done, self.rng) = spec.paged_verify(
+                    eng.params, self.cache, pt, self.tok, self.typ,
+                    self.pos, drafts, dprobs, self.done, self.rng)
+            else:
+                (self.cache, emitted, acc, self.tok, self.prev_tok,
+                 self.pos, self.done) = spec.paged_verify(
+                    eng.params, self.cache, pt, self.tok, self.typ,
+                    self.pos, drafts, self.done)
+        elif spec.stochastic:
             (self.cache, emitted, acc, self.tok, self.prev_tok,
-             self.pos, self.done) = spec.paged_verify(
-                eng.params, self.cache, pt, self.tok, self.typ,
-                self.pos, drafts, self.done)
+             self.pos, self.done, self.rng) = spec.verify(
+                eng.params, self.cache, self.tok, self.typ, self.pos,
+                drafts, dprobs, self.done, self.rng)
         else:
             (self.cache, emitted, acc, self.tok, self.prev_tok,
              self.pos, self.done) = spec.verify(
@@ -362,17 +389,37 @@ class ContinuousBatchingServer:
         """Speculation counters: drafted/accepted/corrected totals, the
         aggregate acceptance rate (accepted drafts / drafted), and the
         per-slot acceptance rate over each slot's CURRENT occupancy
-        (None for slots that have not drafted since admission)."""
+        (None for slots that have not drafted since admission). Paged
+        servers additionally report the KV pool's HBM accounting:
+        ``kv_quant`` mode, total pool bytes (k + v + scale arrays, all
+        layers), and the capacity multiplier vs f32 pools at the same
+        page count — the ``users_per_chip_at_fixed_hbm_x`` lever
+        (ops/kv_quant.py). KV state is TRANSIENT: none of this enters
+        checkpoint fingerprints (tests/test_serving_kv_quant.py pins that a
+        checkpoint roundtrip is kv_quant-agnostic)."""
         if self.spec is None:
-            return {"speculate_k": 0}
-        s = dict(self._spec_totals)
-        s["speculate_k"] = self.spec.gamma
-        s["acceptance_rate"] = (s["accepted"] / s["drafted"]
-                                if s["drafted"] else None)
-        s["per_slot_acceptance"] = [
-            (float(self._accepted[i] / self._drafted[i])
-             if self._drafted[i] else None)
-            for i in range(self.slots)]
+            s: Dict[str, object] = {"speculate_k": 0}
+        else:
+            s = dict(self._spec_totals)
+            s["speculate_k"] = self.spec.gamma
+            s["acceptance_rate"] = (s["accepted"] / s["drafted"]
+                                    if s["drafted"] else None)
+            s["per_slot_acceptance"] = [
+                (float(self._accepted[i] / self._drafted[i])
+                 if self._drafted[i] else None)
+                for i in range(self.slots)]
+        if self.pager is not None:
+            from commefficient_tpu.ops import kv_quant as kvq
+            cfg = self.engine.model.config
+            hd = cfg.n_embd // cfg.n_head
+            args = (self.pager.num_pages, self.pager.page_size,
+                    cfg.n_head, hd, cfg.n_layer)
+            s["kv_quant"] = self.kv_quant
+            s["kv_pool_bytes"] = kvq.pool_bytes(
+                *args, self.kv_quant,
+                base_dtype=np.dtype(cfg.jnp_dtype))
+            s["kv_capacity_multiplier_vs_f32"] = \
+                kvq.capacity_multiplier_vs_f32(*args, self.kv_quant)
         return s
 
     def run(self, max_steps: int = 100_000) -> Dict[int, List[int]]:

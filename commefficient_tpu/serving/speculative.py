@@ -21,6 +21,21 @@ identical to non-speculative decoding under greedy acceptance:
   is the non-speculative greedy stream (the bitwise regression harness
   in tests/test_speculative.py).
 
+Under ``--serve_sample topk`` acceptance switches to the STOCHASTIC
+residual rule of the same two papers: the drafter SAMPLES each draft
+d_i from its top-k distribution p_i and returns the full (B, gamma, V)
+probability tensors alongside the tokens; the verify program computes
+the target's top-k distribution q_i at every window position, accepts
+d_i with probability ``min(1, q_i(d_i) / p_i(d_i))``, and on rejection
+emits a sample from the normalized residual ``max(q_i - p_i, 0)``
+(a bonus token sampled from q_gamma closes a fully-accepted window).
+Each emitted token is marginally distributed exactly as q_i, so the
+accepted-token marginals equal non-speculative top-k sampling
+(tests/test_speculative.py's distribution-equivalence harness) even
+though the streams are not bitwise-comparable. The greedy programs are
+a SEPARATE code path, untouched by the stochastic rule, so greedy
+speculation stays bitwise-identical to the non-speculative stream.
+
 Rejected speculative KV entries are rolled back as pure host
 bookkeeping: the dense/paged write masks make entries above a row's
 accepted frontier unattendable until overwritten, and
@@ -125,13 +140,10 @@ class SpeculativeDecoder:
             raise ValueError(
                 f"speculate_k must be >= 1 to speculate, got {gamma}; "
                 f"use 0 (or omit the flag) to serve non-speculatively")
-        if engine.method != "greedy":
-            raise ValueError(
-                "speculative decoding is greedy-only for now: acceptance "
-                "compares the drafter's argmax stream against the "
-                "target's, and topk sampling would need the stochastic "
-                "accept/resample rule — drop --speculate_k or serve "
-                "with method='greedy'")
+        #: topk engines use the stochastic accept/resample rule; the
+        #: draft/verify signatures differ (rng + draft probs thread
+        #: through), so the server branches on this
+        self.stochastic = engine.method == "topk"
         self.engine = engine
         self.gamma = int(gamma)
         self.slots = int(slots)
@@ -155,10 +167,17 @@ class SpeculativeDecoder:
                 f"position the target can decode at")
         self.dcache = init_decode_cache(dcfg, self.slots, engine.max_len)
         # one compile each for the server's lifetime (asserted via
-        # _cache_size() in tests and the decode_speculative audit)
-        self.draft = jax.jit(self._draft_raw)
-        self.verify = jax.jit(self._verify_raw)
-        self.paged_verify = jax.jit(self._paged_verify_raw)
+        # _cache_size() in tests and the decode_speculative audit);
+        # greedy and stochastic are SEPARATE programs so the greedy
+        # traces stay byte-identical to the pre-stochastic build
+        if self.stochastic:
+            self.draft = jax.jit(self._draft_stoch_raw)
+            self.verify = jax.jit(self._verify_stoch_raw)
+            self.paged_verify = jax.jit(self._paged_verify_stoch_raw)
+        else:
+            self.draft = jax.jit(self._draft_raw)
+            self.verify = jax.jit(self._verify_raw)
+            self.paged_verify = jax.jit(self._paged_verify_raw)
         self.dprefill = jax.jit(self._dprefill_raw)
 
     # ---- drafter programs --------------------------------------------
@@ -205,6 +224,52 @@ class SpeculativeDecoder:
             drafts.append(cur)
             p = p + 1
         return dcache, jnp.stack(drafts, axis=1)
+
+    def _topk_dist(self, logits):
+        """Full-vocab probabilities of the engine's top-k sampling rule
+        applied to ``logits`` (..., V): softmax over the temperature-
+        scaled top-k scores, scattered back to vocab coordinates, zero
+        elsewhere. This is exactly the marginal of
+        ``serving.decode.sample_next(method='topk')`` — the stochastic
+        acceptance rule needs both drafter and target as explicit
+        distributions."""
+        eng = self.engine
+        V = logits.shape[-1]
+        vals, idxs = jax.lax.top_k(
+            logits.astype(jnp.float32) / eng.temperature, eng.top_k)
+        p = jax.nn.softmax(vals, axis=-1)
+        return jnp.sum(jax.nn.one_hot(idxs, V, dtype=jnp.float32)
+                       * p[..., None], axis=-2)
+
+    def _draft_stoch_raw(self, dparams, dcache, prev_tok, prev_typ, tok,
+                         type_tok, pos, rng):
+        """The stochastic twin of ``_draft_raw``: the same catch-up
+        protocol, but each draft is SAMPLED from the drafter's top-k
+        distribution (one rng split per draft, mirroring the
+        non-speculative step's split chain) and the full per-step
+        distributions come back with the tokens — the verify program
+        needs p_i(d_i) and the residual q_i - p_i. Returns
+        (dcache, drafts (B, gamma), dprobs (B, gamma, V), rng)."""
+        from commefficient_tpu.serving.decode import sample_next
+        eng = self.engine
+        zero = jnp.zeros_like(tok)
+        _, dcache = self._dapply(dparams, prev_tok[:, None],
+                                 prev_typ[:, None], dcache,
+                                 jnp.maximum(pos - 1, 0), zero)
+        drafts, dists = [], []
+        cur, p = tok, pos
+        for _ in range(self.gamma):
+            logits, dcache = self._dapply(dparams, cur[:, None],
+                                          type_tok[:, None], dcache, p,
+                                          zero)
+            dists.append(self._topk_dist(logits))
+            cur, rng = sample_next(logits, rng, method="topk",
+                                   top_k=eng.top_k,
+                                   temperature=eng.temperature)
+            drafts.append(cur)
+            p = p + 1
+        return (dcache, jnp.stack(drafts, axis=1),
+                jnp.stack(dists, axis=1), rng)
 
     # ---- target verify + in-program greedy acceptance -----------------
 
@@ -270,9 +335,117 @@ class SpeculativeDecoder:
         garbage page), attention via paged_verify_attention. The host
         allocates frontier pages covering pos..pos+gamma beforehand
         (PagedKVCache.ensure_range) and rolls rejected entries back
-        afterwards (truncate) — both pure bookkeeping."""
-        cache = tuple({"k": p["k"], "v": p["v"], "pt": pt} for p in pools)
+        afterwards (truncate) — both pure bookkeeping. The pool merge
+        is key-generic so quantized pools (scale arrays riding the
+        layer dicts, ops/kv_quant.py) verify through the same body."""
+        cache = tuple({**p, "pt": pt} for p in pools)
         cache, ids, tstar = self._verify_core(params, cache, tok,
                                               type_tok, pos, drafts, done)
-        new_pools = tuple({"k": c["k"], "v": c["v"]} for c in cache)
+        new_pools = tuple({k: v for k, v in c.items() if k != "pt"}
+                          for c in cache)
         return (new_pools,) + self._accept(ids, tstar, pos, done)
+
+    # ---- stochastic acceptance (topk engines; Leviathan/Chen rule) ----
+
+    def _accept_stoch(self, ids, qdist, dprobs, pos, done, rng):
+        """Stochastic acceptance over the verified window — the same
+        masked skeleton as ``_accept`` with the match bit replaced by
+        the residual-distribution rule: draft d_i (written at window
+        index i) is accepted with probability
+        ``min(1, q_{i-1}(d_i) / p_{i-1}(d_i))``; the emission that
+        follows the last accepted draft is a sample from the normalized
+        residual ``max(q - p, 0)`` (or from q_gamma — the bonus token —
+        when the whole window was accepted). Each emitted token is
+        marginally ~ q at its position, so the emitted stream is
+        distributed exactly as non-speculative top-k sampling.
+
+        ``qdist`` (B, gamma+1, V) is the target's top-k distribution at
+        every window position, ``dprobs`` (B, gamma, V) the drafter's
+        distributions the drafts were sampled from. Gates (eos latch,
+        capacity, done) mirror ``_accept`` exactly; note the eos gate
+        reads the accepted DRAFT (the realized emission), not a target
+        argmax."""
+        B, G1 = ids.shape
+        G = G1 - 1
+        eos = jnp.int32(self.engine.eos_id)
+        max_len = self.engine.max_len
+        rng, ku, kf = jax.random.split(rng, 3)
+        # acceptance bits for drafts ids[:, 1:]: q and p evaluated at
+        # the drafted token (p(d) > 0 by construction — d was sampled
+        # from p — the tiny floor only guards the division)
+        q_d = jnp.take_along_axis(qdist[:, :-1], ids[:, 1:, None],
+                                  axis=-1)[..., 0]          # (B, G)
+        p_d = jnp.take_along_axis(dprobs, ids[:, 1:, None],
+                                  axis=-1)[..., 0]          # (B, G)
+        u = jax.random.uniform(ku, (B, G))
+        accept = u < jnp.minimum(q_d / jnp.maximum(p_d, 1e-20), 1.0)
+        ones = jnp.ones((B, 1), bool)
+        match = jnp.concatenate([ones, accept], 1)
+        no_eos = jnp.concatenate([ones, ids[:, 1:] != eos], 1)
+        cap = pos[:, None] + jnp.arange(G1)[None, :] < max_len
+        live = match & no_eos & cap & ~done[:, None]
+        alive = jnp.cumprod(live.astype(jnp.int32), axis=1).astype(bool)
+        acc = alive.sum(axis=1).astype(jnp.int32)
+        # fallback draws: residual distributions for rejections, the
+        # bonus distribution q_gamma at the window end. An identically-
+        # zero residual (q == p pointwise) can never be SELECTED — the
+        # ratio is 1 so the draft always accepts — the uniform stand-in
+        # only keeps the categorical's log finite on those lanes.
+        residual = jnp.maximum(qdist[:, :-1] - dprobs, 0.0)  # (B, G, V)
+        rsum = residual.sum(axis=-1, keepdims=True)
+        residual = jnp.where(rsum > 0, residual, 1.0)
+        fall_dist = jnp.concatenate([residual, qdist[:, -1:]], axis=1)
+        fallback = jax.random.categorical(
+            kf, jnp.log(fall_dist), axis=-1).astype(jnp.int32)  # (B, G1)
+        # emission j: the accepted draft ids[:, j+1] when its accept bit
+        # passed (even if a gate then ended the window — greedy emits
+        # its last tstar the same way), else the fallback sample
+        accept_next = jnp.concatenate(
+            [accept, jnp.zeros((B, 1), bool)], 1)           # (B, G1)
+        draft_next = jnp.concatenate(
+            [ids[:, 1:], ids[:, -1:]], 1)                   # pad: unused
+        realized = jnp.where(accept_next, draft_next, fallback)
+        emitted = jnp.where(alive, realized, eos)
+        last_idx = jnp.maximum(acc - 1, 0)[:, None]
+        last = jnp.take_along_axis(realized, last_idx, axis=1)[:, 0]
+        new_prev = jnp.take_along_axis(ids, last_idx, axis=1)[:, 0]
+        new_done = done | (last == eos) | (pos + acc >= max_len)
+        new_tok = jnp.where(new_done, eos, last)
+        new_pos = jnp.minimum(pos + acc, max_len - 1)
+        return emitted, acc, new_tok, new_prev, new_pos, new_done, rng
+
+    def _verify_core_probs(self, params, cache, tok, type_tok, pos,
+                           drafts):
+        eng = self.engine
+        ids = jnp.concatenate([tok[:, None], drafts], axis=1)
+        B, G1 = ids.shape
+        types = jnp.broadcast_to(type_tok[:, None], (B, G1))
+        lm, _, cache = eng.model.apply(
+            {"params": params}, ids[:, None, :], types[:, None, :],
+            jnp.zeros((B, 1), jnp.int32), train=False, cache=cache,
+            position=pos, verify=True, logits_all=True)
+        return cache, ids, self._topk_dist(lm)              # (B, G1, V)
+
+    def _verify_stoch_raw(self, params, cache, tok, type_tok, pos,
+                          drafts, dprobs, done, rng):
+        """Stochastic verify through the DENSE slot cache: one
+        multi-token forward, acceptance + residual resampling
+        in-program. Returns (cache, emitted (B, gamma+1), acc (B,),
+        new_tok, new_prev, new_pos, new_done, rng)."""
+        cache, ids, qdist = self._verify_core_probs(params, cache, tok,
+                                                    type_tok, pos, drafts)
+        return (cache,) + self._accept_stoch(ids, qdist, dprobs, pos,
+                                             done, rng)
+
+    def _paged_verify_stoch_raw(self, params, pools, pt, tok, type_tok,
+                                pos, drafts, dprobs, done, rng):
+        """The paged stochastic twin — same pool/page-table plumbing as
+        ``_paged_verify_raw`` (quantized pools included), stochastic
+        acceptance instead of greedy."""
+        cache = tuple({**p, "pt": pt} for p in pools)
+        cache, ids, qdist = self._verify_core_probs(params, cache, tok,
+                                                    type_tok, pos, drafts)
+        new_pools = tuple({k: v for k, v in c.items() if k != "pt"}
+                          for c in cache)
+        return (new_pools,) + self._accept_stoch(ids, qdist, dprobs, pos,
+                                                 done, rng)

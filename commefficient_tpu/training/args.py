@@ -150,21 +150,36 @@ def build_parser(default_lr: float = 0.4) -> argparse.ArgumentParser:
     p.add_argument("--serve_sample", choices=("greedy", "topk"),
                    default="greedy",
                    help="serving-time sampling method for the decode "
-                        "engine; greedy is the only method "
-                        "--speculate_k composes with")
+                        "engine; both compose with --speculate_k "
+                        "(greedy-prefix or stochastic acceptance)")
     p.add_argument("--speculate_k", type=int, default=0,
                    help="speculative decoding draft length γ "
                         "(serving/speculative.py): a small drafter "
                         "proposes γ tokens per slot and one multi-token "
-                        "target forward verifies all γ+1 positions, "
-                        "emitting the longest accepted prefix plus one "
+                        "target forward verifies all γ+1 positions. "
+                        "Under --serve_sample greedy, acceptance keeps "
+                        "the longest argmax-matching prefix plus one "
                         "corrected token — output bitwise-identical to "
-                        "non-speculative greedy decode. 0 disables. "
-                        "Greedy-only; composes with paged KV caches and "
-                        "--serve_personalized (base-weights drafter is "
-                        "free). Checkpoint fingerprints record the "
-                        "drafter; a mismatch warns and serves "
-                        "non-speculative")
+                        "non-speculative greedy decode; under topk, the "
+                        "stochastic residual rule keeps the emitted "
+                        "marginals exactly the non-speculative topk "
+                        "distribution. 0 disables. Composes with paged "
+                        "KV caches and --serve_personalized "
+                        "(base-weights drafter is free). Checkpoint "
+                        "fingerprints record the drafter; a mismatch "
+                        "warns and serves non-speculative")
+    p.add_argument("--kv_quant", choices=("none", "int8", "int4"),
+                   default="none",
+                   help="KV page-pool codec for paged serving "
+                        "(ops/kv_quant.py): int8 stores pages with "
+                        "per-page-per-head f32 scales, quantized at "
+                        "write time and dequantized inside the paged "
+                        "attention gather — ~4x pool HBM, so ~4x "
+                        "users_per_chip_at_fixed_hbm_x, with replies "
+                        "under a pinned tolerance contract instead of "
+                        "bitwise parity; int4 is the nibble-packed "
+                        "stretch mode (~8x). 'none' keeps full-precision "
+                        "pools and bitwise greedy parity")
     p.add_argument("--offload_pipeline_depth", type=int, default=2,
                    help="rounds of offloaded output rows that may queue "
                         "for lazy host writeback (api.HostOffloadPipeline)"

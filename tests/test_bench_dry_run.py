@@ -34,7 +34,7 @@ def test_registry_covers_every_row():
     a row cannot exist in one mode and be silently skipped by the
     other."""
     names = [n for n, _ in bench._bench_rows()]
-    assert len(names) == len(set(names)) == 27
+    assert len(names) == len(set(names)) == 29
     for must in ("cifar10_resnet9_fed_rounds_per_sec",
                  "cifar10_resnet9_per_worker_sketch_ab",
                  "gpt2_fetchsgd_per_worker_sketch_ab",
@@ -54,7 +54,9 @@ def test_registry_covers_every_row():
                  "gpt2_decode_tokens_per_sec_chip_b8",
                  "gpt2_decode_tokens_per_sec_chip_b64",
                  "gpt2_decode_paged_tokens_per_sec_ab",
+                 "gpt2_decode_paged_quant_ab",
                  "gpt2_decode_speculative_tokens_per_sec_ab",
+                 "gpt2_decode_speculative_topk_stochastic_ab",
                  "gpt2_decode_speculative_personalized_ab",
                  "serve_personalized_admission_overhead"):
         assert must in names
@@ -141,6 +143,60 @@ def test_speculative_decode_row_traces_draft_and_paged_verify(dry):
     assert status["dry_run"] == "ok"
     assert status["out_leaves"] > 0
     assert breakdown == {}
+
+
+def test_paged_quant_row_audits_jaxpr_and_capacity(dry):
+    """The --kv_quant A/B row's dry run traces the int8 paged step and
+    runs the REAL footprint rule over its jaxpr (no f32 aval of the
+    pool's (num_pages, page_size, H, hd) shape), then asserts the
+    byte-accounted capacity multiplier clears 3x — both contracts are
+    inside the row, so CI's dry-run step enforces them."""
+    status, breakdown = bench.bench_decode_paged_quant_ab()
+    assert status["dry_run"] == "ok"
+    assert status["users_per_chip_at_fixed_hbm_x"] >= 3.0
+    assert breakdown == {}
+
+
+def test_speculative_topk_row_traces_stochastic_programs(dry):
+    """The stochastic-acceptance row traces the rng-threaded draft (full
+    (B, γ, V) drafter distributions out) and the residual-rule paged
+    verify — signature drift in the stochastic twins fails here on
+    CPU."""
+    status, breakdown = bench.bench_decode_speculative_ab(
+        gammas=(0, 4), batches=(8,), method="topk")
+    assert status["dry_run"] == "ok"
+    assert status["out_leaves"] > 0
+    assert breakdown == {}
+
+
+def test_cli_serving_column_preset_expands_to_serving_rows(monkeypatch,
+                                                           capsys):
+    """--rows serving_column is a preset alias for the whole serving
+    stack; stubbed row bodies — this pins the SELECTION."""
+    hit = []
+    for fn in ("bench_generate", "bench_decode_paged_ab",
+               "bench_decode_paged_quant_ab",
+               "bench_decode_speculative_ab",
+               "bench_decode_speculative_personalized",
+               "bench_personalized_admission"):
+        monkeypatch.setattr(bench, fn,
+                            lambda *a, _f=fn, **kw: hit.append(_f))
+    monkeypatch.setattr(sys, "argv",
+                        ["bench.py", "--dry-run",
+                         "--rows", "serving_column"])
+    with pytest.raises(SystemExit) as ex:
+        bench.main()
+    assert ex.value.code == 0
+    out = capsys.readouterr().out
+    assert set(hit) == {"bench_generate", "bench_decode_paged_ab",
+                        "bench_decode_paged_quant_ab",
+                        "bench_decode_speculative_ab",
+                        "bench_decode_speculative_personalized",
+                        "bench_personalized_admission"}
+    assert "gpt2_decode_paged_quant_ab" in out
+    assert "gpt2_decode_speculative_topk_stochastic_ab" in out
+    assert "cifar10" not in out
+    assert "fetchsgd" not in out
 
 
 def test_personalized_admission_row_runs_exactness_contract(dry):

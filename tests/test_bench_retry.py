@@ -173,8 +173,15 @@ def test_main_emits_json_and_exits_zero_despite_failed_metrics(
                              "fixed_tokens_per_sec_b64": 49_000.0,
                              "users_per_chip_at_fixed_hbm_x_b64": 2.1}))
     monkeypatch.setattr(
+        bench, "bench_decode_paged_quant_ab",
+        lambda **kw: (0.98, {"int8_tokens_per_sec_b64": 49_000.0,
+                             "f32_tokens_per_sec_b64": 50_000.0,
+                             "kv_capacity_multiplier_vs_f32": 3.9689,
+                             "users_per_chip_at_fixed_hbm_x_b64": 8.3}))
+    monkeypatch.setattr(
         bench, "bench_decode_speculative_ab",
-        lambda **kw: (1.15, {"spec_g0_b8_tokens_per_sec": 50_000.0,
+        lambda **kw: (1.15, {"method": kw.get("method", "greedy"),
+                             "spec_g0_b8_tokens_per_sec": 50_000.0,
                              "spec_g4_b8_tokens_per_sec": 57_500.0,
                              "acceptance_rate_g4_b8": 0.31,
                              "spec_selfdraft_g8_b8_tokens_per_sec":
@@ -216,7 +223,9 @@ def test_main_emits_json_and_exits_zero_despite_failed_metrics(
     assert "gpt2_fetchsgd_per_worker_sketch_ab" in metrics
     assert "client_store_sketched_codec" in metrics
     assert "gpt2_decode_paged_tokens_per_sec_ab" in metrics
+    assert "gpt2_decode_paged_quant_ab" in metrics
     assert "gpt2_decode_speculative_tokens_per_sec_ab" in metrics
+    assert "gpt2_decode_speculative_topk_stochastic_ab" in metrics
     assert "gpt2_decode_speculative_personalized_ab" in metrics
     assert "serve_personalized_admission_overhead" in metrics
     # the dead metrics are absent from the numbers but present in errors

@@ -16,6 +16,11 @@ The anchors:
   every page returns to the pool at the end (no leaks, no double
   frees);
 * drain() + fresh-server reuse reproduce the same greedy replies;
+* STOCHASTIC acceptance (topk engines): the residual rule's emitted
+  marginals measurably equal the non-speculative top-k distribution at
+  every window position, a self-drafting stochastic server accepts its
+  whole window, and the stochastic programs hold the same one-compile
+  contract;
 * the ``decode_speculative`` graft audit passes on the real paged
   verify and FAILS on the dense-cache mutation.
 """
@@ -241,18 +246,23 @@ def test_config_and_constructor_validation(tiny):
     tok, model, params, engine = tiny
     with pytest.raises(ValueError, match="speculate_k must be >= 0"):
         FedConfig(speculate_k=-1).finalize(100)
-    with pytest.raises(ValueError, match="greedy acceptance"):
-        FedConfig(speculate_k=4, serve_sample="topk").finalize(100)
+    # speculation composes with BOTH sampling methods now (stochastic
+    # acceptance for topk) — the old config refusal is gone
+    FedConfig(speculate_k=4, serve_sample="topk").finalize(100)
     with pytest.raises(ValueError, match="serve_sample"):
         FedConfig(serve_sample="nucleus").finalize(100)
+    with pytest.raises(ValueError, match="kv_quant"):
+        FedConfig(kv_quant="fp8").finalize(100)
     FedConfig(speculate_k=4).finalize(100)      # greedy default: fine
+    FedConfig(kv_quant="int8").finalize(100)
 
     with pytest.raises(ValueError, match="speculate_k must be >= 1"):
         SpeculativeDecoder(engine, gamma=0, slots=2)
     topk_engine = DecodeEngine(model, params, eos_id=engine.eos_id,
                                max_len=48, method="topk")
-    with pytest.raises(ValueError, match="greedy-only"):
-        SpeculativeDecoder(topk_engine, gamma=2, slots=2)
+    # a topk engine constructs a STOCHASTIC decoder instead of raising
+    assert SpeculativeDecoder(topk_engine, gamma=2, slots=2).stochastic
+    assert not SpeculativeDecoder(engine, gamma=2, slots=2).stochastic
     short = GPT2DoubleHeads(GPT2Config.tiny(vocab_size=tok.vocab_size))
     short.config.n_positions = 16               # < engine.max_len
     with pytest.raises(ValueError, match="n_positions"):
@@ -263,6 +273,95 @@ def test_config_and_constructor_validation(tiny):
         SpeculativeDecoder(engine, gamma=2, slots=2,
                            drafter_model=other_vocab,
                            drafter_params=params)
+
+
+def test_stochastic_acceptance_marginals_match_topk(tiny):
+    """The residual rule's theorem, measured: with drafts sampled from
+    the drafter's distribution p and acceptance w.p. min(1, q/p) plus
+    normalized-residual resampling, every emitted token is marginally
+    ~ q — the exact distribution the non-speculative top-k step draws
+    from (``sample_next``'s marginal is ``_topk_dist``, pinned here at
+    the same sample size). One ``_accept_stoch`` call over a large iid
+    batch gives the empirical marginals; position 0 is unconditional,
+    position 1 conditions on the window surviving position 0 (an event
+    independent of position-1 randomness)."""
+    from commefficient_tpu.serving.decode import sample_next
+    tok, model, params, engine = tiny
+    topk_engine = DecodeEngine(model, params, eos_id=engine.eos_id,
+                               max_len=48, method="topk")
+    spec = SpeculativeDecoder(topk_engine, gamma=2, slots=2)
+    assert spec.stochastic
+    V, B = 16, 8192
+    rs = np.random.RandomState(11)
+    qlog = np.asarray(rs.randn(3, V).astype(np.float32) * 2.0)
+    # drafter = perturbed target: enough overlap that acceptance is
+    # common, enough disagreement that rejections are too
+    plog = qlog[:2] + rs.randn(2, V).astype(np.float32) * 0.7
+    q = np.asarray(spec._topk_dist(qlog))     # target dist per position
+    p = np.asarray(spec._topk_dist(plog))     # drafter dist per draft
+
+    # sample_next's marginal IS _topk_dist — the non-speculative stream
+    toks, _ = sample_next(np.broadcast_to(qlog[0], (B, V)),
+                          jax.random.PRNGKey(0), method="topk",
+                          top_k=topk_engine.top_k,
+                          temperature=topk_engine.temperature)
+    freq = np.bincount(np.asarray(toks), minlength=V) / B
+    assert np.abs(freq - q[0]).max() < 0.03
+
+    # drafts sampled from p, verified window accepted stochastically
+    k0, k1, ka = jax.random.split(jax.random.PRNGKey(1), 3)
+    d0 = jax.random.categorical(k0, np.log(np.broadcast_to(
+        p[0] + 1e-30, (B, V))), axis=-1).astype(np.int32)
+    d1 = jax.random.categorical(k1, np.log(np.broadcast_to(
+        p[1] + 1e-30, (B, V))), axis=-1).astype(np.int32)
+    ids = np.stack([np.full(B, 5, np.int32), np.asarray(d0),
+                    np.asarray(d1)], axis=1)
+    qdist = np.broadcast_to(q, (B, 3, V))
+    dprobs = np.broadcast_to(p, (B, 2, V))
+    out = spec._accept_stoch(ids, qdist, dprobs,
+                             np.zeros(B, np.int32),
+                             np.zeros(B, bool), ka)
+    emitted, acc = np.asarray(out[0]), np.asarray(out[1])
+    assert len(out) == 7                      # rng threads back out
+    # position 0: every row emits, marginal must be q_0
+    freq0 = np.bincount(emitted[:, 0], minlength=V)[:V] / B
+    assert np.abs(freq0 - q[0]).max() < 0.03
+    # position 1: rows whose first draft was accepted; still ~ q_1
+    srv1 = emitted[acc >= 2, 1]
+    assert len(srv1) > B // 8                 # acceptance really happens
+    assert (acc < 3).any()                    # rejections really happen
+    freq1 = np.bincount(srv1, minlength=V)[:V] / len(srv1)
+    assert np.abs(freq1 - q[1]).max() < 5 * np.sqrt(0.25 / len(srv1))
+
+
+def test_stochastic_topk_server_end_to_end_self_draft(tiny):
+    """--speculate_k + --serve_sample topk over the paged server: the
+    composition the config layer used to refuse. Self-drafting, so the
+    drafter's top-k distribution equals the target's and the ratio test
+    accepts (up to float jitter between the drafter's dense cache and
+    the target's paged attention); the stochastic draft + verify
+    programs compile once each across the admission churn."""
+    engine, prompts = _engine_and_prompts(tiny, n=4)
+    tok, model, params, _eng = tiny
+    topk_engine = DecodeEngine(model, params, eos_id=engine.eos_id,
+                               max_len=48, method="topk")
+    srv = ContinuousBatchingServer(topk_engine, slots=2, prefill_len=32,
+                                   kv_cache="paged", page_size=8,
+                                   speculate_k=2)
+    assert srv.spec.stochastic
+    budgets = [6, 3, 6, 1]
+    rids = [srv.submit(ids, types, types[-1], budgets[i])
+            for i, (ids, types) in enumerate(prompts)]
+    replies = srv.run()
+    for i, r in enumerate(rids):
+        assert 0 < len(replies[r]) <= budgets[i]
+        assert all(0 <= t < tok.vocab_size for t in replies[r])
+    st = srv.stats()
+    assert st["drafted"] > 0
+    assert st["acceptance_rate"] > 0.99       # self-draft: ratio == 1
+    assert srv.spec.draft._cache_size() == 1
+    assert srv.spec.paged_verify._cache_size() == 1
+    assert srv.pager.pages_in_use == 0
 
 
 def test_speculation_from_checkpoint_gate():
