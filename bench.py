@@ -22,9 +22,21 @@ so vs_baseline is 1.0 by convention.
 
 import argparse
 import json
+import os
+import sys
 import time
 
 import numpy as np
+
+# the decode_tp row builds a tp=2 mesh; a fresh CPU process exposes ONE
+# device unless this flag lands before jax's first import (all jax
+# imports in this module are function-local, so module import is early
+# enough)
+if "jax" not in sys.modules:
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
 
 #: --dry-run: every bench row builds its real setup (model, learner,
 #: device batch) and TRACES its jitted programs via jax.eval_shape, then
@@ -1931,6 +1943,230 @@ def _run_metric(name, fn, errors, retries=2):
             return None
 
 
+def bench_decode_tp_ab(batches=(8, 64), prompt_len=128, new_tokens=64,
+                       page_size=16, tp=2, requests_per_slot=2):
+    """Tensor-parallel serving A/B: the paged continuous-batching server
+    run over the same greedy request stream with a replicated engine
+    (tp=1) and a head-sharded one (tp=2: Megatron params via
+    parallel/tp.py, page pools sharded (num_pages, page_size, H/tp, hd)
+    per shard, host page table unsharded). Tokens/s should be ~flat on
+    one host — the win is CAPACITY: each shard holds 1/tp of the pool
+    HBM, so at fixed per-chip KV HBM a tp-chip fleet serves tp x the
+    concurrent users; the ``users_per_fleet_at_fixed_hbm_x`` entries
+    price that against the measured peak page occupancy. Replies are
+    not compared here (tp greedy parity is pinned token-identical by
+    __graft_entry__.dryrun_multichip and tests/test_serving_multihost).
+
+    Dry-run traces the tp-sharded paged step via eval_shape — the
+    sharding_constraint annotations land in the jaxpr (the
+    serve_multihost audit's subject). Degrades to mesh=None when the
+    process has a single device.
+
+    Returns (tp tokens/s / tp=1 tokens/s at the largest batch,
+    breakdown with both arms' tokens/s + fleet-capacity multipliers)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from commefficient_tpu.models.gpt2 import GPT2Config, GPT2DoubleHeads
+    from commefficient_tpu.serving import (ContinuousBatchingServer,
+                                           DecodeEngine)
+    from commefficient_tpu.serving.paged_cache import PagedKVCache
+
+    P, N = prompt_len, new_tokens
+    S = P + N
+    gcfg = GPT2Config.small(vocab_size=50262)
+    gcfg.n_positions = max(gcfg.n_positions, S)
+    gcfg.dropout = 0.0
+    gcfg.dtype = "bfloat16"
+    model = GPT2DoubleHeads(gcfg)
+    rng = np.random.RandomState(0)
+    key = jax.random.PRNGKey(0)
+    sample_in = (jnp.zeros((1, 1, 8), jnp.int32),
+                 jnp.zeros((1, 1, 8), jnp.int32),
+                 jnp.zeros((1, 1), jnp.int32))
+    mesh = (Mesh(np.asarray(jax.devices()[:tp]), ("model",))
+            if jax.device_count() >= tp else None)
+
+    if DRY_RUN:
+        B = batches[0]
+        params = jax.eval_shape(
+            lambda r: model.init(r, *sample_in, train=False), key)["params"]
+        engine = DecodeEngine(model, params, eos_id=50261, max_len=S,
+                              method="greedy", mesh=mesh)
+        pager = PagedKVCache(slots=B, max_len=S, prefill_len=P,
+                             page_size=page_size)
+        pools = jax.eval_shape(
+            lambda: engine.init_paged_pools(pager.num_pages, page_size))
+        vec = jax.ShapeDtypeStruct((B,), jnp.int32)
+        out = jax.eval_shape(
+            engine._paged_step_raw, params, pools,
+            jax.ShapeDtypeStruct((B, pager.max_pages), jnp.int32),
+            vec, vec, vec, key, jax.ShapeDtypeStruct((B,), jnp.bool_))
+        return {"dry_run": "ok", "tp": engine.tp,
+                "out_leaves": len(jax.tree.leaves(out))}, {}
+
+    if mesh is None:
+        return None     # single-device process: nothing to A/B
+
+    params = model.init(key, *sample_in, train=False)["params"]
+    engines = {
+        1: DecodeEngine(model, params, eos_id=50261, max_len=S,
+                        method="greedy"),
+        tp: DecodeEngine(model, params, eos_id=50261, max_len=S,
+                         method="greedy", mesh=mesh),
+    }
+    breakdown = {"prompt_len": P, "new_tokens": N, "page_size": page_size,
+                 "tp": tp, "requests_per_slot": requests_per_slot}
+    ratio = None
+    for B in batches:
+        reqs = []
+        for _ in range(requests_per_slot * B):
+            L = int(rng.randint(P // 2, P + 1))
+            reqs.append((rng.randint(0, 50000, L).astype(np.int32).tolist(),
+                         [1] * L))
+        for arm, eng in engines.items():
+            def make(eng=eng):
+                return ContinuousBatchingServer(eng, slots=B,
+                                                prefill_len=P,
+                                                kv_cache="paged",
+                                                page_size=page_size)
+
+            warm = make()                       # compile all programs
+            warm.submit(reqs[0][0], reqs[0][1], 1, 2)
+            warm.run()
+            srv = make()
+            for ids, types in reqs:
+                srv.submit(ids, types, 1, N)
+            got, peak = 0, 0
+            t0 = time.perf_counter()
+            while srv._queue or any(r is not None for r in srv._slot_req):
+                for _, toks in srv.step():
+                    got += len(toks)
+                peak = max(peak, srv.pager.pages_in_use)
+            dt = time.perf_counter() - t0
+            breakdown[f"tp{arm}_tokens_per_sec_b{B}"] = round(got / dt, 1)
+            # each shard physically holds peak/arm pages' worth of KV
+            # bytes, so a fleet of ``arm`` chips at the same per-chip KV
+            # HBM budget as the dense single-chip slab holds arm x the
+            # users the slab reserved for
+            breakdown[f"users_per_fleet_at_fixed_hbm_x_b{B}_tp{arm}"] = \
+                round(arm * B * srv.pager.max_pages / max(peak, 1), 2)
+        ratio = (breakdown[f"tp{tp}_tokens_per_sec_b{B}"]
+                 / breakdown[f"tp1_tokens_per_sec_b{B}"])
+    return round(ratio, 4), breakdown
+
+
+def bench_serve_disagg_latency(B=8, prompt_len=128, new_tokens=64,
+                               page_size=16, burst=24):
+    """Decode-latency-under-prefill-burst A/B: the paged server with a
+    full decode pool gets ``burst`` queued requests dumped on it, and
+    every ``step()``'s wall time is recorded until the stream drains.
+    Unified admission runs EVERY fitting prefill before the decode
+    step, so in-flight decodes hiccup by a full B=1 prefill per retired
+    slot; disaggregation (--serve_disagg) steps the decode pool first
+    and budgets admissions at ``prefill_slots`` per step, so the decode
+    cadence stays flat. The p50 should roughly match across arms (most
+    steps admit nothing) — the p99, the number a latency SLO is written
+    against, is where the burst shows up.
+
+    Dry-run traces the shared paged programs and constructs the
+    disaggregated server (the split slot pools + budget validation are
+    host-side wiring this exercises).
+
+    Returns (unified p99 / disagg p99 — >1 means disaggregation
+    flattened the tail, breakdown with both arms' p50/p99 ms)."""
+    import jax
+    import jax.numpy as jnp
+
+    from commefficient_tpu.models.gpt2 import GPT2Config, GPT2DoubleHeads
+    from commefficient_tpu.serving import (ContinuousBatchingServer,
+                                           DecodeEngine)
+    from commefficient_tpu.serving.paged_cache import PagedKVCache
+
+    P, N = prompt_len, new_tokens
+    S = P + N
+    gcfg = GPT2Config.small(vocab_size=50262)
+    gcfg.n_positions = max(gcfg.n_positions, S)
+    gcfg.dropout = 0.0
+    gcfg.dtype = "bfloat16"
+    model = GPT2DoubleHeads(gcfg)
+    rng = np.random.RandomState(0)
+    key = jax.random.PRNGKey(0)
+    sample_in = (jnp.zeros((1, 1, 8), jnp.int32),
+                 jnp.zeros((1, 1, 8), jnp.int32),
+                 jnp.zeros((1, 1), jnp.int32))
+
+    if DRY_RUN:
+        params = jax.eval_shape(
+            lambda r: model.init(r, *sample_in, train=False), key)["params"]
+        engine = DecodeEngine(model, params, eos_id=50261, max_len=S,
+                              method="greedy")
+        srv = ContinuousBatchingServer(engine, slots=B, prefill_len=P,
+                                       kv_cache="paged",
+                                       page_size=page_size,
+                                       disaggregate=True)
+        pager = srv.pager
+        ids1 = jax.ShapeDtypeStruct((1, P), jnp.int32)
+        cache1 = jax.eval_shape(lambda: engine.init_cache(1))
+        _, row_cache = jax.eval_shape(
+            engine._prefill_raw, params, cache1, ids1, ids1,
+            jax.ShapeDtypeStruct((1,), jnp.int32))
+        pools = jax.eval_shape(
+            lambda: engine.init_paged_pools(pager.num_pages, page_size))
+        pools = jax.eval_shape(
+            engine._paged_insert_raw, pools, row_cache,
+            jax.ShapeDtypeStruct((pager.prefill_pages,), jnp.int32))
+        vec = jax.ShapeDtypeStruct((B,), jnp.int32)
+        out = jax.eval_shape(
+            engine._paged_step_raw, params, pools,
+            jax.ShapeDtypeStruct((B, pager.max_pages), jnp.int32),
+            vec, vec, vec, key, jax.ShapeDtypeStruct((B,), jnp.bool_))
+        return {"dry_run": "ok", "prefill_slots": srv.prefill_slots,
+                "out_leaves": len(jax.tree.leaves(out))}, {}
+
+    params = model.init(key, *sample_in, train=False)["params"]
+    engine = DecodeEngine(model, params, eos_id=50261, max_len=S,
+                          method="greedy")
+    breakdown = {"slots": B, "prompt_len": P, "new_tokens": N,
+                 "page_size": page_size, "burst": burst}
+    p99s = {}
+    for arm, disagg in (("unified", False), ("disagg", True)):
+        def make(disagg=disagg):
+            return ContinuousBatchingServer(engine, slots=B,
+                                            prefill_len=P,
+                                            kv_cache="paged",
+                                            page_size=page_size,
+                                            disaggregate=disagg)
+
+        def prompt():
+            L = int(rng.randint(P // 2, P + 1))
+            return (rng.randint(0, 50000, L).astype(np.int32).tolist(),
+                    [1] * L)
+
+        warm = make()                           # compile all programs
+        warm.submit(*prompt(), 1, 2)
+        warm.run()
+        srv = make()
+        for _ in range(B):                      # fill the decode pool
+            srv.submit(*prompt(), 1, N)
+        srv.step()
+        for _ in range(burst):                  # then the prefill burst
+            srv.submit(*prompt(), 1, N)
+        lat = []
+        while srv._queue or any(r is not None for r in srv._slot_req):
+            t0 = time.perf_counter()
+            srv.step()
+            lat.append((time.perf_counter() - t0) * 1e3)
+        p50, p99 = np.percentile(np.asarray(lat), [50, 99])
+        breakdown[f"{arm}_decode_step_p50_ms"] = round(float(p50), 2)
+        breakdown[f"{arm}_decode_step_p99_ms"] = round(float(p99), 2)
+        p99s[arm] = float(p99)
+        if disagg:
+            breakdown["prefill_slots"] = srv.prefill_slots
+    return round(p99s["unified"] / max(p99s["disagg"], 1e-9), 4), breakdown
+
+
 def _bench_rows():
     """Every bench row, as (name, zero-arg closure) pairs — the single
     registry both the timed JSON path and ``--dry-run`` iterate, so a row
@@ -2004,6 +2240,10 @@ def _bench_rows():
          lambda: bench_decode_speculative_personalized()),
         ("serve_personalized_admission_overhead",
          lambda: bench_personalized_admission()),
+        ("gpt2_decode_tp_tokens_per_sec_ab",
+         lambda: bench_decode_tp_ab()),
+        ("serve_disagg_decode_latency_ab",
+         lambda: bench_serve_disagg_latency()),
     ]
 
 
@@ -2014,7 +2254,7 @@ def _bench_rows():
 ROW_PRESETS = {
     "serving_column": ("gpt2_decode_tokens_per_sec_chip_*",
                        "*decode_paged*", "*speculative*",
-                       "*personalized*"),
+                       "*personalized*", "*decode_tp*", "*disagg*"),
 }
 
 
@@ -2302,6 +2542,31 @@ def main():
                     "measures how far k-sparse deltas move the argmax "
                     "stream"})
         if spec_pers is not None else None)
+    tp_ab = res["gpt2_decode_tp_tokens_per_sec_ab"]
+    add("gpt2_decode_tp_tokens_per_sec_ab",
+        round(tp_ab[0], 4) if tp_ab is not None else None,
+        "speedup_x",
+        dict(tp_ab[1], **{
+            "note": "--serve_tp 2: head-sharded Megatron engine + "
+                    "per-shard page pools vs the replicated engine, same "
+                    "greedy stream; tokens/s ~flat on one host by design "
+                    "— the users_per_fleet_at_fixed_hbm_x entries are "
+                    "the capacity win (each shard holds 1/tp of the "
+                    "pool HBM; greedy parity pinned token-identical by "
+                    "dryrun_multichip)"})
+        if tp_ab is not None else None)
+    disagg_ab = res["serve_disagg_decode_latency_ab"]
+    add("serve_disagg_decode_latency_ab",
+        round(disagg_ab[0], 4) if disagg_ab is not None else None,
+        "speedup_x",
+        dict(disagg_ab[1], **{
+            "note": "--serve_disagg: decode pool steps first, admissions "
+                    "budgeted at prefill_slots per step vs unified "
+                    "admit-everything-then-step, same stream + prefill "
+                    "burst; the ratio is unified p99 step latency over "
+                    "disagg p99 (>1 = the burst no longer stalls "
+                    "in-flight decodes)"})
+        if disagg_ab is not None else None)
     pers = res["serve_personalized_admission_overhead"]
     add("serve_personalized_admission_overhead",
         pers["admission_delta_apply_ms"] if pers is not None else None,

@@ -20,7 +20,19 @@ See ``docs/ANALYSIS.md`` for the rule catalog and how to add/allowlist.
 
 from __future__ import annotations
 
+import os
+import sys
 from typing import Optional, Sequence
+
+# the serve_multihost target builds a tp=2 mesh; a fresh CPU process
+# exposes ONE device unless this flag lands before jax's first import
+# (tests/conftest.py sets the same flag for the pytest tier, so the
+# guard below is a no-op there)
+if "jax" not in sys.modules:
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax
 

@@ -27,7 +27,8 @@ def main(argv=None) -> int:
                                  "buffered", "client_store", "gpt2",
                                  "attention", "sketch", "decode",
                                  "decode_paged", "decode_paged_quant",
-                                 "decode_speculative", "all"])
+                                 "decode_speculative", "serve_multihost",
+                                 "all"])
     parser.add_argument("--no-retrace", action="store_true",
                         help="skip the (compile-heavy) retrace guards")
     parser.add_argument("--prng-lint", action="store_true",

@@ -433,6 +433,96 @@ class TransferRule:
 
 
 # --------------------------------------------------------------------------
+# sharded KV pools (tensor-parallel serving)
+# --------------------------------------------------------------------------
+
+
+class ShardedPoolRule:
+    """Tensor-parallel serving keeps the KV page pools sharded per head
+    (parallel/tp.py): each model-axis shard physically holds a
+    ``(num_pages, page_size, H/tp, hd)`` slice, so the paged gathers and
+    decode attention stay shard-local. The layout is pinned in-program
+    with ``with_sharding_constraint``, which traces to
+    ``sharding_constraint`` eqns whose ``sharding`` param carries the
+    PartitionSpec — the auditable artifact this rule walks.
+
+    For every pool-shaped constraint — ``(num_pages, page_size, H, hd)``
+    avals (the jaxpr records GLOBAL shapes under GSPMD), plus the
+    ``(num_pages, H)`` quantization scale rows when bound — the spec's
+    head axis must name the model axis. A REPLICATED spec on a
+    pool-shaped aval is the all-gather-the-pool mutation: GSPMD would
+    materialize every shard's pages on every device, the exact per-step
+    HBM/interconnect cost pool sharding exists to remove. Zero
+    pool-shaped constraints in the whole program means the layout is
+    unpinned (nothing stops a replicated fallback), which also fails.
+    """
+
+    name = "sharded_pool"
+
+    def __init__(self, axis: str = "model"):
+        self.axis = axis
+
+    @staticmethod
+    def _spec_entry(spec, i):
+        if spec is None or i >= len(spec):
+            return ()
+        entry = spec[i]
+        if entry is None:
+            return ()
+        return entry if isinstance(entry, tuple) else (entry,)
+
+    def check(self, sites: Sequence[EqnSite], stats: WalkStats,
+              dims: dict) -> RuleReport:
+        report = RuleReport(rule=self.name, ok=True)
+        try:
+            pool = (int(dims["num_pages"]), int(dims["page_size"]),
+                    int(dims["H"]), int(dims["hd"]))
+        except KeyError:
+            report.notes = "pool dims unbound; rule inactive"
+            return report
+        scale = (pool[0], pool[2])
+        # head-axis index per tracked shape
+        tracked = {pool: 2, scale: 1}
+        report.notes = (f"pool {pool} / scale {scale} sharding "
+                        f"constraints must shard heads along "
+                        f"'{self.axis}'")
+        pool_constraints = 0
+        for site in sites:
+            report.checked_eqns += 1
+            if site.primitive != "sharding_constraint":
+                continue
+            for var in site.eqn.outvars:
+                aval = getattr(var, "aval", None)
+                shape = tuple(getattr(aval, "shape", ()))
+                if shape not in tracked:
+                    continue
+                pool_constraints += 1
+                sharding = site.eqn.params.get("sharding")
+                spec = getattr(sharding, "spec", None)
+                head = tracked[shape]
+                if self.axis not in self._spec_entry(spec, head):
+                    report.ok = False
+                    report.violations.append(Violation(
+                        rule=self.name, path=site.path,
+                        primitive=site.primitive, shape=shape,
+                        message=f"pool-shaped aval constrained to "
+                                f"{spec} — heads not sharded along "
+                                f"'{self.axis}' (a replicated pool is "
+                                f"the all-gather GSPMD would "
+                                f"materialize on every shard)"))
+        if pool_constraints == 0:
+            report.ok = False
+            report.violations.append(Violation(
+                rule=self.name, path="", primitive="<absent>",
+                shape=pool,
+                message=f"no sharding_constraint pins the "
+                        f"{pool} pool layout — nothing stops the pools "
+                        f"falling back to replicated placement"))
+        report.notes += f"; {pool_constraints} pool constraints checked"
+        return report
+
+
+# --------------------------------------------------------------------------
 # dtype policy
 # --------------------------------------------------------------------------
 

@@ -26,7 +26,7 @@ from .report import AuditReport
 from .retrace import check_retrace
 from .rules import (DEFAULT_PATTERNS, BatchedSketchRule,
                     BucketedTransmitRule, FootprintRule, RuleReport,
-                    ShapePattern, TransferRule)
+                    ShapePattern, ShardedPoolRule, TransferRule)
 from .walker import walk
 
 
@@ -539,7 +539,7 @@ def attention_target(bwd: bool = True) -> AuditTarget:
 # KV-cached decode (serving path)
 # --------------------------------------------------------------------------
 
-def _decode_engine(batch=3):
+def _decode_engine(batch=3, mesh=None):
     from commefficient_tpu.models.gpt2 import GPT2Config, GPT2DoubleHeads
     from commefficient_tpu.serving import DecodeEngine
 
@@ -551,7 +551,8 @@ def _decode_engine(batch=3):
     params = model.init(jax.random.PRNGKey(0), ids, ids,
                         jnp.zeros((1, 1), jnp.int32),
                         train=False)["params"]
-    return DecodeEngine(model, params, eos_id=V - 1, max_len=S), S
+    return DecodeEngine(model, params, eos_id=V - 1, max_len=S,
+                        mesh=mesh), S
 
 
 def decode_target(program: str = "step") -> AuditTarget:
@@ -921,6 +922,114 @@ def decode_paged_quant_target(mutate: bool = False) -> AuditTarget:
         retrace=retrace)
 
 
+def serve_multihost_target(mutate: bool = False) -> AuditTarget:
+    """The tensor-parallel paged decode step (serving/decode.py with a
+    ``mesh`` + parallel/tp.py ``constrain_kv_cache_tp``).
+
+    The multi-host contract is that the page pools are SHARDED along
+    the KV head axis — each shard holds ``(num_pages, page_size,
+    H/tp, hd)`` and the paged gathers stay shard-local, because heads
+    are a batch dimension in every attention einsum.  Inside the traced
+    step that contract is visible as ``sharding_constraint`` eqns
+    pinning every pool-shaped aval to a spec with the model axis at the
+    head index; a REPLICATED pool constraint is the all-gather GSPMD
+    would materialize on every shard (tp× the pool HBM plus a per-step
+    collective over the whole KV state), and ZERO pool constraints
+    means the layout is unpinned and GSPMD is free to pick exactly
+    that.  The transfer rule proves the page-table bookkeeping stays a
+    host-side allocator: no per-step host gather of the sharded pools.
+    The retrace guard drives a REAL tp=2 paged server — admissions,
+    evictions, shared prompt pages, page-boundary crossings — and
+    asserts the compile cache stays at ONE program (per-shard pool
+    shapes never leak into trace-time Python).
+
+    ``mutate=True`` re-pins every pool leaf to the replicated spec
+    ``P()`` before the step — the layout an all-gather reintroduction
+    would produce — and the audit must FAIL on it
+    (tests/test_serving_multihost.py pins this).
+
+    Needs ``jax.device_count() >= 2`` (the CLI forces 8 virtual CPU
+    devices; tests/conftest.py does the same).
+    """
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as PSpec
+
+    if jax.device_count() < 2:
+        raise RuntimeError(
+            "serve_multihost needs >= 2 devices for the tp=2 mesh — on "
+            "CPU set XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+            "BEFORE jax is imported")
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("model",))
+    engine, S = _decode_engine(mesh=mesh)
+    B = 3
+    cfg = engine.model.config
+    page_size = 8
+    tok = jnp.asarray(np.full((B,), 5, np.int32))
+    typ = jnp.asarray(np.full((B,), 7, np.int32))
+    pos = jnp.asarray(np.array([3, 9, 1], np.int32))
+    rng0 = jax.random.PRNGKey(2)
+    done = jnp.zeros((B,), bool)
+    max_pages = S // page_size
+    num_pages = 1 + B * max_pages
+
+    def trace():
+        pools = engine.init_paged_pools(num_pages, page_size)
+        pt = jnp.zeros((B, max_pages), jnp.int32)
+        if mutate:
+            rep = NamedSharding(mesh, PSpec())
+
+            def step_replicated(params, pools, pt, tok, typ, pos, rng,
+                                done):
+                pools = tuple(
+                    {k: jax.lax.with_sharding_constraint(v, rep)
+                     for k, v in layer.items()} for layer in pools)
+                return engine._paged_step_raw(params, pools, pt, tok,
+                                              typ, pos, rng, done)
+
+            return jax.make_jaxpr(step_replicated)(
+                engine.params, pools, pt, tok, typ, pos, rng0, done)
+        return jax.make_jaxpr(engine._paged_step_raw)(
+            engine.params, pools, pt, tok, typ, pos, rng0, done)
+
+    def retrace():
+        from commefficient_tpu.serving import ContinuousBatchingServer
+        srv = ContinuousBatchingServer(engine, slots=B, prefill_len=16,
+                                       kv_cache="paged",
+                                       page_size=page_size)
+        rs = np.random.RandomState(43)
+        V = cfg.vocab_size
+        shared = [int(t) for t in rs.randint(0, V - 1, 16)]
+
+        def drive(i):
+            if len(srv._queue) < 2:
+                # same churn as decode_paged, but every step runs the
+                # head-sharded program: per-shard pool shapes must not
+                # leak into trace-time Python
+                srv.submit(shared, [7] * 16, 7, 5)
+                srv.submit(shared, [7] * 16, 7, 3)
+                pl = int(rs.randint(3, 12))
+                srv.submit([int(t) for t in rs.randint(0, V - 1, pl)],
+                           [7] * pl, 7, 4)
+            srv.step()
+
+        return check_retrace(engine.paged_step, None, repeats=3,
+                             warmup=1, drive=drive)
+
+    return AuditTarget(
+        name="serve_multihost/step" + ("(mutated)" if mutate else ""),
+        description="tensor-parallel (tp=2) paged decode step; every "
+                    "pool-shaped aval must be pinned head-sharded along "
+                    "'model' — replicated pools (the all-gather layout) "
+                    "are banned"
+                    + (" [replicated-pool mutation — must fail]"
+                       if mutate else ""),
+        trace=trace,
+        dims={"num_pages": num_pages, "page_size": page_size,
+              "H": cfg.n_head, "hd": cfg.n_embd // cfg.n_head},
+        rules=(ShardedPoolRule("model"), TransferRule()),
+        retrace=retrace)
+
+
 # --------------------------------------------------------------------------
 # sketch ops
 # --------------------------------------------------------------------------
@@ -993,6 +1102,8 @@ def build_targets(name: str) -> list:
         return [decode_speculative_target()]
     if name == "decode_paged_quant":
         return [decode_paged_quant_target()]
+    if name == "serve_multihost":
+        return [serve_multihost_target()]
     if name == "client_store":
         return [client_store_target()]
     if name == "all":
@@ -1003,8 +1114,9 @@ def build_targets(name: str) -> list:
                 + build_targets("sketch") + build_targets("decode")
                 + build_targets("decode_paged")
                 + build_targets("decode_speculative")
-                + build_targets("decode_paged_quant"))
+                + build_targets("decode_paged_quant")
+                + build_targets("serve_multihost"))
     raise ValueError(f"unknown audit target {name!r} (round|round_bucketed|"
                      f"sketch_batched|buffered|client_store|gpt2|attention|"
                      f"sketch|decode|decode_paged|decode_speculative|"
-                     f"decode_paged_quant|all)")
+                     f"decode_paged_quant|serve_multihost|all)")

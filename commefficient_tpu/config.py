@@ -24,6 +24,13 @@ SERVER_MODES = ("sync", "buffered")
 #: CountSketch of the error row (bounded-divergence heavy-hitter memory).
 CLIENT_STATE_REPS = ("dense", "sparse", "sketched")
 
+#: head counts of the checkpoint families the serving stack loads —
+#: what --serve_tp must divide for the per-head KV (and kv_quant scale
+#: row) sharding to split cleanly. Unknown checkpoints defer to the
+#: DecodeEngine's n_head check at engine construction.
+_KNOWN_N_HEAD = {"gpt2": 12, "gpt2-medium": 16, "gpt2-large": 20,
+                 "gpt2-xl": 25, "openai-gpt": 12}
+
 
 @dataclass(frozen=True)
 class FedConfig:
@@ -144,6 +151,25 @@ class FedConfig:
     # stretch mode (nibble-packed, ~8x). Quantized pools move
     # users_per_chip_at_fixed_hbm_x (ROADMAP item 3).
     kv_quant: str = "none"
+    # Tensor-parallel serving degree (parallel/tp.py + serving/decode.py):
+    # params take the Megatron column/row layout on the mesh's 'model'
+    # axis and every KV cache / page pool shards its HEAD axis, so the
+    # decode attention and paged page gathers stay shard-local. 1 =
+    # single-chip serving. Requires a mesh with a 'model' axis of
+    # exactly this size, and the served model's n_head must divide by it
+    # (KV heads shard; DecodeEngine refuses otherwise). Greedy replies
+    # stay token-identical to tp=1 (__graft_entry__.dryrun_multichip).
+    serve_tp: int = 1
+    # Serving slot count for the continuous-batching server (the decode
+    # batch width; serving/server.py).
+    serve_slots: int = 8
+    # Prefill/decode disaggregation (serving/server.py): the decode pool
+    # steps first every server step and admissions (the compute-bound
+    # B=1 prefill program) are budgeted after it, so a prefill burst
+    # cannot stall admitted decode slots. Requires the paged KV cache
+    # (the handoff between pools is a page-table row write) and at
+    # least 2 slots (one per pool).
+    serve_disagg: bool = False
     # Offload pipeline depth (api.HostOffloadPipeline): how many rounds of
     # output rows may sit in the lazy-writeback queue while their (W, d)
     # device buffers stay alive. 2 = double buffering (gather round t+1 /
@@ -269,6 +295,43 @@ class FedConfig:
             raise ValueError(
                 f"--kv_quant must be 'none', 'int8' or 'int4', got "
                 f"{self.kv_quant!r}")
+        if self.serve_tp < 1:
+            raise ValueError(f"--serve_tp must be >= 1, got "
+                             f"{self.serve_tp}")
+        if self.serve_slots < 1:
+            raise ValueError(f"--serve_slots must be >= 1, got "
+                             f"{self.serve_slots}")
+        if self.serve_tp > 1:
+            if "model" not in self.mesh_axis_names:
+                raise ValueError(
+                    f"--serve_tp {self.serve_tp} shards the served "
+                    f"params and KV heads along a 'model' mesh axis, "
+                    f"but the mesh has axes "
+                    f"{self.mesh_axis_names} — add model="
+                    f"{self.serve_tp} to --mesh")
+            msize = self.mesh_shape[
+                self.mesh_axis_names.index("model")]
+            if msize != self.serve_tp:
+                raise ValueError(
+                    f"--serve_tp {self.serve_tp} does not match the "
+                    f"mesh's model axis size {msize}; the decode step "
+                    f"shards across exactly the model axis")
+            if self.kv_quant != "none":
+                # quantized pools carry (num_pages, n_head) f32 scale
+                # rows that shard per head with the pools; the split
+                # must be exact or a head's scale would straddle shards
+                n_head = _KNOWN_N_HEAD.get(self.model_checkpoint)
+                if n_head is not None and n_head % self.serve_tp:
+                    raise ValueError(
+                        f"--kv_quant {self.kv_quant} per-head scale "
+                        f"rows cannot shard cleanly: "
+                        f"{self.model_checkpoint!r} has {n_head} heads, "
+                        f"not divisible by --serve_tp {self.serve_tp}")
+        if self.serve_disagg and self.serve_slots < 2:
+            raise ValueError(
+                f"--serve_disagg splits serving into prefill and decode "
+                f"slot pools; --serve_slots {self.serve_slots} < 2 "
+                f"cannot hold both pools")
         if self.client_state == "sketched":
             if self.error_type != "local":
                 raise ValueError(

@@ -118,7 +118,13 @@ def decode_attention(q, k, v, q_pos, *,
 
     Every query attends at least to its own just-written position, so
     no fully-masked rows exist and no zero-emission correction is
-    needed. f32 scores via MXU accumulation (see full_attention)."""
+    needed. f32 scores via MXU accumulation (see full_attention).
+
+    Tensor-parallel contract (parallel/tp.py): H is a pure batch axis
+    of both einsums here, so a cache head-sharded along the 'model'
+    mesh axis keeps this whole function shard-local — GSPMD introduces
+    NO collective inside it (the block's single psum sits after the
+    downstream output projection)."""
     B, Tq, H, D = q.shape
     S = k.shape[1]
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
@@ -171,7 +177,16 @@ def paged_verify_attention(q, k_pool, v_pool, page_table, q_pos, *,
     / ``decode_speculative`` audits' forbidden dense-cache shape cannot
     appear. f32 scores via MXU accumulation (see full_attention); the
     (m, p) contraction runs in logical order, matching the dense path's
-    key order."""
+    key order.
+
+    Tensor-parallel contract (parallel/tp.py): H is a batch axis of
+    the gather AND both einsums, so pools head-sharded along the
+    'model' mesh axis — (num_pages, page_size, H/tp, D) per shard,
+    scales (num_pages, H/tp) — keep the page gather and the whole
+    score/softmax/weighted-sum pipeline shard-local. The page_table
+    index is replicated (tiny int32), so GSPMD lowers the gather to a
+    local dynamic-gather per shard with NO collective; the block's one
+    psum sits after the downstream output projection."""
     B, Tq, H, D = q.shape
     P = k_pool.shape[1]
     M = page_table.shape[1]
@@ -205,7 +220,9 @@ def paged_decode_attention(q, k_pool, v_pool, page_table, q_pos, *,
     general Tq (identical einsums, so the Tq=1 trace is bitwise the
     pre-speculative program). Kept as the named decode entry point the
     serving step and its docs refer to. ``k_scale``/``v_scale`` select
-    the quantized-pool form (in-gather dequant; ops/kv_quant.py)."""
+    the quantized-pool form (in-gather dequant; ops/kv_quant.py).
+    Inherits paged_verify_attention's tensor-parallel contract: head-
+    sharded pools keep the Tq=1 step shard-local, no collectives."""
     return paged_verify_attention(q, k_pool, v_pool, page_table, q_pos,
                                   k_scale=k_scale, v_scale=v_scale)
 

@@ -65,3 +65,62 @@ def gpt2_tp_shardings(params, mesh: Mesh, axis: str = "model"):
 def shard_params_tp(params, mesh: Mesh, axis: str = "model"):
     """Place a replicated param tree onto the mesh in the TP layout."""
     return jax.device_put(params, gpt2_tp_shardings(params, mesh, axis))
+
+
+# --------------------------------------------------------------------------
+# serving: KV cache / page-pool sharding
+# --------------------------------------------------------------------------
+#
+# The decode-path KV state shards along the HEAD axis, matching the
+# qkv column layout above: each model-axis shard holds H/tp heads of
+# every cache row or pool page, so the paged gathers
+# (ops/attention.paged_verify_attention) and the decode attention
+# einsums — all of which treat heads as a batch dimension — stay local
+# to the shard. Per-page-per-head quantization scale rows
+# ((num_pages, H) f32, ops/kv_quant.py) shard along the same axis so a
+# page's scales live with its heads.
+
+def kv_spec_for(key: str, leaf, axis: str = "model") -> P:
+    """PartitionSpec for one KV-cache leaf, by dict key.
+
+    ``k``/``v`` leaves — dense slabs (B, max_len, H, hd) and page pools
+    (num_pages, page_size, H, hd) alike — shard the head axis (dim 2);
+    ``k_scale``/``v_scale`` rows (num_pages, H) shard their head axis
+    (dim 1); anything else (the traced page table ``pt``) is replicated.
+    """
+    if key in ("k", "v") and leaf.ndim == 4:
+        # no trailing None: jit outputs normalize the spec to its
+        # shortest form, and the spec must match EXACTLY or the step
+        # recompiles when allocated pools are replaced by step outputs
+        return P(None, None, axis)
+    if key in ("k_scale", "v_scale") and leaf.ndim == 2:
+        return P(None, axis)
+    return P()
+
+
+def kv_cache_specs(cache, axis: str = "model"):
+    """PartitionSpec pytree for a decode cache / paged-pool tuple-of-
+    dicts (models/gpt2.init_decode_cache or DecodeEngine.init_paged_pools
+    layout)."""
+    return tuple({k: kv_spec_for(k, v, axis) for k, v in layer.items()}
+                 for layer in cache)
+
+
+def constrain_kv_cache_tp(cache, mesh: Mesh, axis: str = "model"):
+    """Pin the head-sharded layout on a cache/pool pytree.
+
+    Under tracing this is ``with_sharding_constraint`` — it lands as the
+    ``sharding_constraint`` eqns the ``serve_multihost`` audit keys on.
+    Eagerly (cache allocation) it is ``device_put``: a COMMITTED array
+    whose sharding matches what the step program produces, so the jit
+    cache sees one input-sharding signature from the first call instead
+    of recompiling when host-fresh buffers become device-resident
+    outputs."""
+    def pin(k, v):
+        sh = NamedSharding(mesh, kv_spec_for(k, v, axis))
+        if isinstance(v, jax.core.Tracer):
+            return jax.lax.with_sharding_constraint(v, sh)
+        return jax.device_put(v, sh)
+
+    return tuple({k: pin(k, v) for k, v in layer.items()}
+                 for layer in cache)

@@ -63,12 +63,40 @@ class DecodeEngine:
     def __init__(self, model, params, *, eos_id: int,
                  max_len: Optional[int] = None, pad_id: int = 0,
                  method: str = "greedy", top_k: int = 8,
-                 temperature: float = 0.7):
+                 temperature: float = 0.7, mesh=None,
+                 tp_axis: str = "model"):
         if method not in ("greedy", "topk"):
             raise ValueError(f"method must be 'greedy' or 'topk', "
                              f"got {method!r}")
         cfg = model.config
         self.model = model
+        # tensor-parallel serving (parallel/tp.py): params take the
+        # Megatron column/row layout and every KV cache / page pool
+        # shards its HEAD axis along ``tp_axis``, so the decode
+        # attention einsums (heads are a batch dim throughout,
+        # ops/attention.py) and the paged page gathers stay shard-local
+        # and GSPMD closes each block with one psum. The host page
+        # table stays the single global allocator — it is replicated
+        # (tiny int32), only pool CONTENT shards.
+        self.mesh = None
+        self.tp_axis = tp_axis
+        self.tp = 1
+        if mesh is not None and tp_axis in mesh.shape \
+                and mesh.shape[tp_axis] > 1:
+            tp = int(mesh.shape[tp_axis])
+            if cfg.n_head % tp:
+                raise ValueError(
+                    f"tensor-parallel serving shards the KV head axis: "
+                    f"n_head {cfg.n_head} must be divisible by the "
+                    f"'{tp_axis}' mesh axis size {tp}")
+            self.mesh = mesh
+            self.tp = tp
+            leaves = jax.tree_util.tree_leaves(params)
+            if leaves and isinstance(leaves[0], jax.Array):
+                from commefficient_tpu.parallel.tp import shard_params_tp
+                params = shard_params_tp(params, mesh, tp_axis)
+            # else: abstract params (bench --dry-run eval_shape path) —
+            # placement is moot, the _constrain annotations still trace
         self.params = params
         self.max_len = int(max_len) if max_len else int(cfg.n_positions)
         if self.max_len > cfg.n_positions:
@@ -93,8 +121,33 @@ class DecodeEngine:
     # ---- programs (raw = untraced, for eval_shape / make_jaxpr) -------
 
     def init_cache(self, batch_size: int):
-        return init_decode_cache(self.model.config, batch_size,
-                                 self.max_len)
+        return self._constrain(init_decode_cache(self.model.config,
+                                                 batch_size, self.max_len))
+
+    def _constrain(self, cache):
+        """Pin the head-sharded TP layout on a cache/pool pytree (no-op
+        for single-device engines, so their traces are unchanged).
+        Works eagerly at allocation and under tracing inside the step
+        programs, where it lands as the ``sharding_constraint`` eqns
+        the ``serve_multihost`` audit target keys on."""
+        if self.mesh is None:
+            return cache
+        from commefficient_tpu.parallel.tp import constrain_kv_cache_tp
+        return constrain_kv_cache_tp(cache, self.mesh, self.tp_axis)
+
+    def commit_replicated(self, *arrays):
+        """Place host-built per-row state (tok/pos/done/rng) on the TP
+        mesh, replicated and COMMITTED, so every step-program input
+        keeps one sharding signature from the first call — host-fresh
+        uncommitted buffers becoming device-resident outputs would
+        otherwise recompile the step once per transition. No-op without
+        a mesh."""
+        if self.mesh is None:
+            return arrays if len(arrays) > 1 else arrays[0]
+        from jax.sharding import NamedSharding, PartitionSpec
+        sh = NamedSharding(self.mesh, PartitionSpec())
+        out = tuple(jax.device_put(a, sh) for a in arrays)
+        return out if len(out) > 1 else out[0]
 
     def _apply(self, params, ids2d, types2d, cache, pos, logits_at):
         B = ids2d.shape[0]
@@ -108,7 +161,9 @@ class DecodeEngine:
         """Fill the cache from padded prompts ids/types (B, P); return
         (logits (B, V) at each row's last_idx, cache)."""
         pos0 = jnp.zeros((ids.shape[0],), jnp.int32)
-        return self._apply(params, ids, types, cache, pos0, last_idx)
+        logits, cache = self._apply(params, ids, types,
+                                    self._constrain(cache), pos0, last_idx)
+        return logits, self._constrain(cache)
 
     def _step_raw(self, params, cache, tok, type_tok, pos, rng, done):
         """Advance every row one token.
@@ -119,14 +174,14 @@ class DecodeEngine:
         ``eos_id`` so hosts can truncate without per-row bookkeeping."""
         zero = jnp.zeros_like(tok)
         logits, cache = self._apply(params, tok[:, None], type_tok[:, None],
-                                    cache, pos, zero)
+                                    self._constrain(cache), pos, zero)
         nxt, rng = sample_next(logits, rng, method=self.method,
                                top_k=self.top_k,
                                temperature=self.temperature)
         new_done = done | (nxt == self.eos_id) | (pos + 1 >= self.max_len)
         nxt = jnp.where(done, jnp.int32(self.eos_id), nxt)
         new_pos = jnp.minimum(pos + 1, self.max_len - 1)
-        return cache, nxt, new_pos, rng, new_done
+        return self._constrain(cache), nxt, new_pos, rng, new_done
 
     def init_paged_pools(self, num_pages: int, page_size: int,
                          kv_quant: str = "none"):
@@ -148,18 +203,20 @@ class DecodeEngine:
         hd = cfg.n_embd // cfg.n_head
         if kv_quant == "none":
             shape = (int(num_pages), int(page_size), cfg.n_head, hd)
-            return tuple({"k": jnp.zeros(shape, cfg.jnp_dtype),
-                          "v": jnp.zeros(shape, cfg.jnp_dtype)}
-                         for _ in range(cfg.n_layer))
+            return self._constrain(
+                tuple({"k": jnp.zeros(shape, cfg.jnp_dtype),
+                       "v": jnp.zeros(shape, cfg.jnp_dtype)}
+                      for _ in range(cfg.n_layer)))
         shape = (int(num_pages), int(page_size), cfg.n_head,
                  kvq.packed_head_dim(hd, kv_quant))
         sshape = (int(num_pages), cfg.n_head)
         dt = kvq.pool_dtype(kv_quant)
-        return tuple({"k": jnp.zeros(shape, dt),
-                      "v": jnp.zeros(shape, dt),
-                      "k_scale": jnp.zeros(sshape, jnp.float32),
-                      "v_scale": jnp.zeros(sshape, jnp.float32)}
-                     for _ in range(cfg.n_layer))
+        return self._constrain(
+            tuple({"k": jnp.zeros(shape, dt),
+                   "v": jnp.zeros(shape, dt),
+                   "k_scale": jnp.zeros(sshape, jnp.float32),
+                   "v_scale": jnp.zeros(sshape, jnp.float32)}
+                  for _ in range(cfg.n_layer)))
 
     def _paged_step_raw(self, params, pools, pt, tok, type_tok, pos, rng,
                         done):
@@ -172,12 +229,13 @@ class DecodeEngine:
         (init_paged_pools(kv_quant=...)) carry their scale arrays in the
         same dicts; the merge is key-generic so both layouts share this
         one program body (distinct compiles — the pytree differs)."""
-        cache = tuple({**p, "pt": pt} for p in pools)
+        cache = tuple({**p, "pt": pt} for p in self._constrain(pools))
         zero = jnp.zeros_like(tok)
         logits, cache = self._apply(params, tok[:, None], type_tok[:, None],
                                     cache, pos, zero)
-        new_pools = tuple({k: v for k, v in c.items() if k != "pt"}
-                          for c in cache)
+        new_pools = self._constrain(
+            tuple({k: v for k, v in c.items() if k != "pt"}
+                  for c in cache))
         nxt, rng = sample_next(logits, rng, method=self.method,
                                top_k=self.top_k,
                                temperature=self.temperature)
@@ -204,7 +262,7 @@ class DecodeEngine:
         from commefficient_tpu.ops import kv_quant as kvq
         n = dst.shape[0]
         out = []
-        for pool, row in zip(pools, row_cache):
+        for pool, row in zip(self._constrain(pools), row_cache):
             P = pool["k"].shape[1]
 
             def pages_of(r):
@@ -224,7 +282,7 @@ class DecodeEngine:
                     return pl.at[dst].set(pages.astype(pl.dtype))
                 out.append({"k": put(pool["k"], row["k"]),
                             "v": put(pool["v"], row["v"])})
-        return tuple(out)
+        return self._constrain(tuple(out))
 
     def _generate_raw(self, params, ids, types, lengths, reply_type, rng,
                       *, max_new):

@@ -48,6 +48,29 @@ target forward, emitted stream bitwise-identical to the non-speculative
 greedy stream. Rejected paged entries roll back host-side
 (``PagedKVCache.truncate``). Still exactly one draft + one verify
 program for the server's lifetime, and still ONE host pull per step.
+
+Multi-host serving (docs/SERVING.md "Multi-host") composes three
+orthogonal pieces on top:
+
+* TENSOR-PARALLEL DECODE — an engine built with ``mesh=`` shards params
+  (Megatron column/row, parallel/tp.py) and every KV pool's HEAD axis
+  along the 'model' mesh axis; the server is layout-blind (the same
+  step calls run GSPMD-sharded), initial slot-row state is committed
+  replicated at construction (``engine.commit_replicated``) so every
+  step program keeps ONE sharding signature — the compile-cache-at-1
+  invariant survives tp>1.
+* OWNER-AFFINITY ROUTING — with a SHARDED personalization store
+  (HostArenaStore num_shards>1) slots split into contiguous per-shard
+  pools and ``submit(user_id=...)`` routes to the pool of
+  ``store.owner(user_id)``, so a user's O(k) row reads/writes stay on
+  the shard holding the row; a full owner pool makes the request WAIT
+  (rows never cross shards) while anonymous requests spill into any
+  free slot (counted in ``stats()['spilled_per_shard']``).
+* PREFILL/DECODE DISAGGREGATION — ``disaggregate=True`` runs decode
+  FIRST each step and caps admissions at ``prefill_slots``, so a
+  prefill burst can never stall the resident decode rows; the handoff
+  between pools is one paged page-table row write (see the constructor
+  comment), which is why it requires ``kv_cache="paged"``.
 """
 
 from __future__ import annotations
@@ -78,7 +101,8 @@ class ContinuousBatchingServer:
                  page_size: int = 16, num_pages: int = None,
                  share_prefix: bool = True, personalize=None,
                  speculate_k: int = 0, drafter_model=None,
-                 drafter_params=None, kv_quant: str = "none"):
+                 drafter_params=None, kv_quant: str = "none",
+                 disaggregate: bool = False, prefill_slots: int = None):
         from commefficient_tpu.ops import kv_quant as kvq
         if prefill_len > engine.max_len:
             raise ValueError(f"prefill_len {prefill_len} exceeds cache "
@@ -91,12 +115,50 @@ class ContinuousBatchingServer:
             raise ValueError("kv_quant is a property of the paged pools "
                              "(ops/kv_quant.py) — serve with "
                              "kv_cache='paged' or kv_quant='none'")
+        if kv_quant != "none" and engine.tp > 1 \
+                and engine.model.config.n_head % engine.tp:
+            raise ValueError(
+                f"kv_quant scale rows are (num_pages, n_head) and shard "
+                f"per head: n_head {engine.model.config.n_head} must "
+                f"divide by tp {engine.tp}")
         self.engine = engine
         self.slots = int(slots)
         self.prefill_len = int(prefill_len)
         self.kv_cache = kv_cache
         self.kv_quant = kv_quant
         self.personalize = personalize
+        # ---- prefill/decode disaggregation ---------------------------
+        # With ``disaggregate=True`` admission (the compute-bound B=1
+        # prefill program) and decode (the bandwidth-bound step program)
+        # run as separate pools inside each ``step()``: the decode pool
+        # steps FIRST, every step, and at most ``prefill_slots``
+        # admissions follow it — so a prefill burst (a deep queue) can
+        # never insert more than prefill_slots prefill dispatches
+        # between consecutive decode steps, and admitted decode slots
+        # see flat latency. The handoff between the pools is the paged
+        # KV page table: the prefill pool packs its B=1 row into pool
+        # pages (pager.admit -> paged_insert) and writes one page-table
+        # row + slot row, after which the decode pool's unchanged step
+        # program serves the request — which is why disaggregation
+        # requires kv_cache='paged'.
+        self.disaggregate = bool(disaggregate)
+        if self.disaggregate:
+            if kv_cache != "paged":
+                raise ValueError(
+                    "disaggregated prefill hands off KV state through "
+                    "the paged page table — serve with kv_cache='paged'")
+            if self.slots < 2:
+                raise ValueError(
+                    f"disaggregation splits prefill and decode into two "
+                    f"pools; slots {self.slots} < 2 cannot hold both")
+            self.prefill_slots = int(prefill_slots) if prefill_slots \
+                else max(1, self.slots // 4)
+            if not 1 <= self.prefill_slots < self.slots:
+                raise ValueError(
+                    f"prefill_slots {self.prefill_slots} must be in "
+                    f"[1, slots) so the decode pool is never empty")
+        else:
+            self.prefill_slots = None
         B = self.slots
         if kv_cache == "paged":
             from commefficient_tpu.serving.paged_cache import PagedKVCache
@@ -114,14 +176,40 @@ class ContinuousBatchingServer:
         else:
             self.pager = None
             self.cache = engine.init_cache(B)
-        self.tok = jnp.full((B,), engine.pad_id, jnp.int32)
-        self.typ = jnp.zeros((B,), jnp.int32)
-        self.pos = jnp.zeros((B,), jnp.int32)
-        self.done = jnp.ones((B,), bool)        # free lanes stay latched
-        self.rng = jax.random.PRNGKey(seed)
-        self._queue: deque = deque()
+        self.tok, self.typ, self.pos, self.done, self.rng = \
+            engine.commit_replicated(
+                jnp.full((B,), engine.pad_id, jnp.int32),
+                jnp.zeros((B,), jnp.int32),
+                jnp.zeros((B,), jnp.int32),
+                jnp.ones((B,), bool),           # free lanes stay latched
+                jax.random.PRNGKey(seed))
+        # ---- owner-affinity routing ----------------------------------
+        # The personalization store is sharded (HostArenaStore
+        # num_shards): user cid's row lives on shard owner(cid) =
+        # cid // rows_per_shard. Slots partition into the same number of
+        # contiguous per-shard pools, and a personalized request is only
+        # ever admitted into its OWNER's pool — its O(k) row read/write
+        # and its weight-delta residency stay on one shard. Anonymous
+        # requests queue on the shared ``_queue`` and SPILL (work-steal)
+        # into whichever shard has a free slot, so affinity never idles
+        # capacity.
+        self.num_shards = int(getattr(getattr(personalize, "store", None),
+                                      "num_shards", 1) or 1)
+        if B % self.num_shards:
+            raise ValueError(
+                f"slots {B} must divide evenly across the store's "
+                f"{self.num_shards} shards (contiguous per-shard slot "
+                f"pools)")
+        self.slots_per_shard = B // self.num_shards
+        self._queue: deque = deque()            # anonymous / shared
+        self._shard_queue = [deque() for _ in range(self.num_shards)]
+        self._free_slots = [
+            list(range(s * self.slots_per_shard,
+                       (s + 1) * self.slots_per_shard))
+            for s in range(self.num_shards)]
+        self._admitted_per_shard = np.zeros((self.num_shards,), np.int64)
+        self._spilled_per_shard = np.zeros((self.num_shards,), np.int64)
         self._slot_req: List[_Request] = [None] * B
-        self._free = list(range(B))
         self._next_rid = 0
         self._insert = jax.jit(self._insert_raw)
         self._set_row = jax.jit(self._set_row_raw)
@@ -137,8 +225,9 @@ class ContinuousBatchingServer:
             self.spec = SpeculativeDecoder(
                 engine, gamma=speculate_k, slots=B,
                 drafter_model=drafter_model, drafter_params=drafter_params)
-            self.prev_tok = jnp.full((B,), engine.pad_id, jnp.int32)
-            self.prev_typ = jnp.zeros((B,), jnp.int32)
+            self.prev_tok, self.prev_typ = engine.commit_replicated(
+                jnp.full((B,), engine.pad_id, jnp.int32),
+                jnp.zeros((B,), jnp.int32))
             self._set_prev = jax.jit(self._set_prev_raw)
             self._drafted = np.zeros((B,), np.int64)
             self._accepted = np.zeros((B,), np.int64)
@@ -173,6 +262,10 @@ class ContinuousBatchingServer:
 
     def submit(self, ids: Sequence[int], types: Sequence[int],
                reply_type: int, max_new: int, user_id=None) -> int:
+        """Queue a request. A ``user_id`` routes it to the slot pool of
+        the shard OWNING that user's personalization row
+        (HostArenaStore.owner); anonymous requests join the shared queue
+        and spill into any free slot."""
         if len(ids) > self.prefill_len:
             raise ValueError(f"prompt length {len(ids)} exceeds "
                              f"prefill_len {self.prefill_len}")
@@ -181,10 +274,22 @@ class ContinuousBatchingServer:
                              "personalization index attached")
         rid = self._next_rid
         self._next_rid += 1
-        self._queue.append(_Request(rid, list(ids), list(types),
-                                    int(reply_type), int(max_new),
-                                    user_id))
+        req = _Request(rid, list(ids), list(types), int(reply_type),
+                       int(max_new), user_id)
+        if user_id is not None:
+            self._shard_queue[self._owner_shard(user_id)].append(req)
+        else:
+            self._queue.append(req)
         return rid
+
+    def _owner_shard(self, user_id) -> int:
+        return int(self.personalize.store.owner(int(user_id)))
+
+    def _shard_of_slot(self, slot: int) -> int:
+        return int(slot) // self.slots_per_shard
+
+    def _queued(self) -> bool:
+        return bool(self._queue) or any(bool(q) for q in self._shard_queue)
 
     def _params_for(self, req: _Request):
         """Admission-time served params: base, or base + the user's
@@ -203,78 +308,121 @@ class ContinuousBatchingServer:
             self.engine.params = self.personalize.evict(
                 self.engine.params, req.user_id)
 
-    def _admit(self) -> List[Tuple[int, List[int]]]:
-        eng = self.engine
+    def _admit(self, budget: int = None) -> List[Tuple[int, List[int]]]:
+        """Admit queued requests into free slots, owner-affine: shard
+        s's slot pool serves shard s's queue first, then steals from the
+        shared anonymous queue. A personalized request whose owner pool
+        is full WAITS (its row never crosses shards) — the next release
+        in that pool admits it before any anonymous spill. ``budget``
+        (disaggregated servers) caps admissions — i.e. prefill
+        dispatches — per call."""
         finished = []
-        while self._free and self._queue:
-            req = self._queue.popleft()
-            slot = self._free.pop()
-            P, L = self.prefill_len, len(req.ids)
-            ids = np.full((1, P), eng.pad_id, np.int32)
-            typ = np.full((1, P), eng.pad_id, np.int32)
-            ids[0, :L] = req.ids
-            typ[0, :L] = req.types
-            params = self._params_for(req)
-            logits, row_cache = eng.prefill(
-                params, eng.init_cache(1), jnp.asarray(ids),
-                jnp.asarray(typ), jnp.asarray([L - 1], jnp.int32))
-            first, self.rng = eng.sample(logits, self.rng)
-            t = int(np.asarray(first)[0])       # admission-time sync
-            if t == eng.eos_id or req.max_new <= 0:
-                finished.append((req.rid, []))
-                self._free.append(slot)
-                self._evict_user(req)
-                continue
-            req.out.append(t)
-            if req.max_new == 1 or L >= eng.max_len:
-                finished.append((req.rid, list(req.out)))
-                self._free.append(slot)
-                self._evict_user(req)
-                continue
-            if self.pager is not None:
-                dst = self.pager.admit(slot, req.ids, req.types,
-                                       shareable=req.user_id is None)
-                self.cache = eng.paged_insert(self.cache, row_cache,
-                                              jnp.asarray(dst))
-            else:
-                self.cache = self._insert(self.cache, row_cache,
-                                          jnp.int32(slot))
-            self.tok, self.typ, self.pos, self.done = self._set_row(
-                self.tok, self.typ, self.pos, self.done, jnp.int32(slot),
-                jnp.int32(t), jnp.int32(req.reply_type), jnp.int32(L))
-            if self.spec is not None:
-                # drafter twin of the target prefill — always BASE
-                # params, so a personalized admission drafts for free
-                drow = self.spec.dprefill(
-                    self.spec.dparams, self.spec.init_drafter_row(),
-                    jnp.asarray(ids), jnp.asarray(typ),
-                    jnp.asarray([L - 1], jnp.int32))
-                self.spec.dcache = self._insert(self.spec.dcache, drow,
-                                                jnp.int32(slot))
-                # next catch-up rewrites the last PROMPT token at L-1
-                self.prev_tok, self.prev_typ = self._set_prev(
-                    self.prev_tok, self.prev_typ, jnp.int32(slot),
-                    jnp.int32(int(req.ids[-1])),
-                    jnp.int32(int(req.types[-1])))
-                self._drafted[slot] = 0
-                self._accepted[slot] = 0
-            self._slot_req[slot] = req
+        admitted, progress = 0, True
+        while progress and (budget is None or admitted < budget):
+            progress = False
+            for s in range(self.num_shards):
+                if budget is not None and admitted >= budget:
+                    break
+                if not self._free_slots[s]:
+                    continue
+                if self._shard_queue[s]:
+                    req, spilled = self._shard_queue[s].popleft(), False
+                elif self._queue:
+                    req, spilled = self._queue.popleft(), \
+                        self.num_shards > 1
+                else:
+                    continue
+                slot = self._free_slots[s].pop()
+                self._admitted_per_shard[s] += 1
+                if spilled:
+                    self._spilled_per_shard[s] += 1
+                self._admit_one(req, slot, finished)
+                admitted += 1
+                progress = True
         return finished
+
+    def _admit_one(self, req: _Request, slot: int, finished) -> None:
+        """Prefill ``req`` and graft it into ``slot`` (the B=1 prefill
+        program + page-table/slot-row handoff)."""
+        eng = self.engine
+        P, L = self.prefill_len, len(req.ids)
+        ids = np.full((1, P), eng.pad_id, np.int32)
+        typ = np.full((1, P), eng.pad_id, np.int32)
+        ids[0, :L] = req.ids
+        typ[0, :L] = req.types
+        params = self._params_for(req)
+        logits, row_cache = eng.prefill(
+            params, eng.init_cache(1), jnp.asarray(ids),
+            jnp.asarray(typ), jnp.asarray([L - 1], jnp.int32))
+        first, self.rng = eng.sample(logits, self.rng)
+        t = int(np.asarray(first)[0])       # admission-time sync
+        if t == eng.eos_id or req.max_new <= 0:
+            finished.append((req.rid, []))
+            self._free_slots[self._shard_of_slot(slot)].append(slot)
+            self._evict_user(req)
+            return
+        req.out.append(t)
+        if req.max_new == 1 or L >= eng.max_len:
+            finished.append((req.rid, list(req.out)))
+            self._free_slots[self._shard_of_slot(slot)].append(slot)
+            self._evict_user(req)
+            return
+        if self.pager is not None:
+            dst = self.pager.admit(slot, req.ids, req.types,
+                                   shareable=req.user_id is None)
+            self.cache = eng.paged_insert(self.cache, row_cache,
+                                          jnp.asarray(dst))
+        else:
+            self.cache = self._insert(self.cache, row_cache,
+                                      jnp.int32(slot))
+        self.tok, self.typ, self.pos, self.done = self._set_row(
+            self.tok, self.typ, self.pos, self.done, jnp.int32(slot),
+            jnp.int32(t), jnp.int32(req.reply_type), jnp.int32(L))
+        if self.spec is not None:
+            # drafter twin of the target prefill — always BASE
+            # params, so a personalized admission drafts for free
+            drow = self.spec.dprefill(
+                self.spec.dparams, self.spec.init_drafter_row(),
+                jnp.asarray(ids), jnp.asarray(typ),
+                jnp.asarray([L - 1], jnp.int32))
+            self.spec.dcache = self._insert(self.spec.dcache, drow,
+                                            jnp.int32(slot))
+            # next catch-up rewrites the last PROMPT token at L-1
+            self.prev_tok, self.prev_typ = self._set_prev(
+                self.prev_tok, self.prev_typ, jnp.int32(slot),
+                jnp.int32(int(req.ids[-1])),
+                jnp.int32(int(req.types[-1])))
+            self._drafted[slot] = 0
+            self._accepted[slot] = 0
+        self._slot_req[slot] = req
 
     def _retire(self, slot: int, finished) -> None:
         req = self._slot_req[slot]
         finished.append((req.rid, list(req.out)))
         self._slot_req[slot] = None
-        self._free.append(slot)
+        self._free_slots[self._shard_of_slot(slot)].append(slot)
         self.done = self._release(self.done, jnp.int32(slot))
         if self.pager is not None:
             self.pager.release(slot)
         self._evict_user(req)
 
     def step(self) -> List[Tuple[int, List[int]]]:
-        """Admit, advance every slot one token, retire. Returns the
-        requests finished this step as (rid, reply_tokens)."""
-        finished = self._admit()
+        """Advance the server one step; returns the requests finished
+        this step as (rid, reply_tokens).
+
+        Unified (default): admit everything that fits, then advance
+        every slot one token and retire. Disaggregated: the DECODE pool
+        steps first — its cadence never waits on the queue — then at
+        most ``prefill_slots`` admissions run their prefills (the
+        handoff into the decode pool is a page-table row write)."""
+        if self.disaggregate:
+            finished = self._decode_round([])
+            finished.extend(self._admit(budget=self.prefill_slots))
+            return finished
+        return self._decode_round(self._admit())
+
+    def _decode_round(self, finished) -> List[Tuple[int, List[int]]]:
+        """One decode step over the active slots (+ retirement)."""
         active = [s for s, r in enumerate(self._slot_req) if r is not None]
         if not active:
             return finished
@@ -420,12 +568,34 @@ class ContinuousBatchingServer:
                 base_dtype=np.dtype(cfg.jnp_dtype))
             s["kv_capacity_multiplier_vs_f32"] = \
                 kvq.capacity_multiplier_vs_f32(*args, self.kv_quant)
+        # multi-host axes: TP degree, prefill/decode split, and per-shard
+        # routing — admitted/spilled per slot pool, plus the store's own
+        # shard read/write counters when a personalization index is
+        # attached, so bench rows can report routing skew directly
+        s["tp"] = self.engine.tp
+        s["disaggregated"] = self.disaggregate
+        if self.disaggregate:
+            s["prefill_slots"] = self.prefill_slots
+        s["num_shards"] = self.num_shards
+        s["slots_per_shard"] = self.slots_per_shard
+        s["admitted_per_shard"] = [int(x) for x in
+                                   self._admitted_per_shard]
+        s["spilled_per_shard"] = [int(x) for x in self._spilled_per_shard]
+        total_admitted = int(self._admitted_per_shard.sum())
+        s["routing_skew"] = (
+            float(self._admitted_per_shard.max()
+                  / (total_admitted / self.num_shards))
+            if total_admitted else None)
+        if self.personalize is not None:
+            store = self.personalize.store
+            s["store_shard_reads"] = [int(x) for x in store.shard_reads]
+            s["store_shard_writes"] = [int(x) for x in store.shard_writes]
         return s
 
     def run(self, max_steps: int = 100_000) -> Dict[int, List[int]]:
         """Step until every submitted request has a reply."""
         replies: Dict[int, List[int]] = {}
-        while self._queue or any(r is not None for r in self._slot_req):
+        while self._queued() or any(r is not None for r in self._slot_req):
             for rid, toks in self.step():
                 replies[rid] = toks
             max_steps -= 1
@@ -440,16 +610,24 @@ class ContinuousBatchingServer:
         Returns ``(replies, leftovers)``: ``replies`` maps rid ->
         reply tokens for every request that had already been admitted
         (their decode completes here — admitted work is never thrown
-        away); ``leftovers`` is the undispatched queue in submission
-        order, as ``(ids, types, reply_type, max_new)`` tuples a
-        replacement server can re-``submit`` verbatim. Because slot rows
+        away); ``leftovers`` is the undispatched queue — owner-shard and
+        anonymous queues merged back into submission order — as
+        ``(ids, types, reply_type, max_new)`` tuples (plus a trailing
+        ``user_id`` for personalized requests, so re-submission routes
+        to the same owner shard) a replacement server can re-``submit``
+        verbatim. Because slot rows
         decode independently and greedy sampling is deterministic,
         resubmitting a leftover on a fresh server over the same
         checkpoint yields the reply this server would have produced
         (tests/test_decode.py)."""
+        queued = sorted([r for q in [self._queue] + self._shard_queue
+                         for r in q], key=lambda r: r.rid)
         leftovers = [(list(r.ids), list(r.types), r.reply_type, r.max_new)
-                     for r in self._queue]
+                     + ((r.user_id,) if r.user_id is not None else ())
+                     for r in queued]
         self._queue.clear()
+        for q in self._shard_queue:
+            q.clear()
         replies: Dict[int, List[int]] = {}
         while any(r is not None for r in self._slot_req):
             for rid, toks in self.step():

@@ -338,11 +338,13 @@ class SpeculativeDecoder:
         afterwards (truncate) — both pure bookkeeping. The pool merge
         is key-generic so quantized pools (scale arrays riding the
         layer dicts, ops/kv_quant.py) verify through the same body."""
-        cache = tuple({**p, "pt": pt} for p in pools)
+        cache = tuple({**p, "pt": pt}
+                      for p in self.engine._constrain(pools))
         cache, ids, tstar = self._verify_core(params, cache, tok,
                                               type_tok, pos, drafts, done)
-        new_pools = tuple({k: v for k, v in c.items() if k != "pt"}
-                          for c in cache)
+        new_pools = self.engine._constrain(
+            tuple({k: v for k, v in c.items() if k != "pt"}
+                  for c in cache))
         return (new_pools,) + self._accept(ids, tstar, pos, done)
 
     # ---- stochastic acceptance (topk engines; Leviathan/Chen rule) ----
@@ -442,10 +444,12 @@ class SpeculativeDecoder:
         """The paged stochastic twin — same pool/page-table plumbing as
         ``_paged_verify_raw`` (quantized pools included), stochastic
         acceptance instead of greedy."""
-        cache = tuple({**p, "pt": pt} for p in pools)
+        cache = tuple({**p, "pt": pt}
+                      for p in self.engine._constrain(pools))
         cache, ids, qdist = self._verify_core_probs(params, cache, tok,
                                                     type_tok, pos, drafts)
-        new_pools = tuple({k: v for k, v in c.items() if k != "pt"}
-                          for c in cache)
+        new_pools = self.engine._constrain(
+            tuple({k: v for k, v in c.items() if k != "pt"}
+                  for c in cache))
         return (new_pools,) + self._accept_stoch(ids, qdist, dprobs, pos,
                                                  done, rng)

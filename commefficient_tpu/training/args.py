@@ -180,6 +180,30 @@ def build_parser(default_lr: float = 0.4) -> argparse.ArgumentParser:
                         "bitwise parity; int4 is the nibble-packed "
                         "stretch mode (~8x). 'none' keeps full-precision "
                         "pools and bitwise greedy parity")
+    p.add_argument("--serve_tp", type=int, default=1,
+                   help="tensor-parallel serving degree (parallel/tp.py "
+                        "+ serving/decode.py): served params take the "
+                        "Megatron column/row layout along the mesh's "
+                        "'model' axis and every KV cache / page pool "
+                        "shards its head axis, so decode attention and "
+                        "paged gathers stay shard-local while the host "
+                        "page table stays the single global allocator. "
+                        "Requires --mesh with model=<this value> and a "
+                        "head count divisible by it; greedy replies stay "
+                        "token-identical to tp=1. 1 = single-chip")
+    p.add_argument("--serve_slots", type=int, default=8,
+                   help="continuous-batching slot count (the decode "
+                        "batch width, serving/server.py)")
+    p.add_argument("--serve_disagg", action="store_true",
+                   help="disaggregate prefill from decode "
+                        "(serving/server.py): the decode pool steps "
+                        "first every server step and admissions (the "
+                        "compute-bound B=1 prefill program) run under a "
+                        "per-step budget after it, handing KV state to "
+                        "the decode pool through a paged page-table row "
+                        "write — a prefill burst cannot stall admitted "
+                        "decode slots. Requires the paged KV cache and "
+                        ">= 2 slots")
     p.add_argument("--offload_pipeline_depth", type=int, default=2,
                    help="rounds of offloaded output rows that may queue "
                         "for lazy host writeback (api.HostOffloadPipeline)"

@@ -114,6 +114,9 @@ def test_gpt2_ring_seq_parallel_matches_single_device():
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow  # ~30s 1-core CPU: shard_map ring compile; the seq
+# axis stays covered tier-1 by the ring logits-parity tests above and
+# end-to-end by dryrun_multichip part 6
 def test_seq_dp_lm_train_step_matches_single_device():
     # 2D mesh (clients=2, seq=4): dp+sp gradients must equal the
     # single-device computation of the same global loss
@@ -206,6 +209,8 @@ def test_gpt2_tensor_parallel_matches_single_device():
     assert shard_shape[1] == k0.shape[1] // 4
 
 
+@pytest.mark.slow  # ~10s compile on 1-core CPU; the pp path stays covered
+# end-to-end by __graft_entry__.dryrun_multichip part 8
 def test_gpt2_pipeline_parallel_matches_single_device():
     # GPipe pipeline over a 'stage' axis: LM logits must match the plain
     # forward, and gradients must flow through the ppermute loop
@@ -355,6 +360,8 @@ def test_ring_mc_logits_replicated_across_seq_shards_under_dropout():
         np.testing.assert_array_equal(out[0], out[s])
 
 
+@pytest.mark.slow  # ~68s 1-core CPU: ring + dropout recompile of the
+# full train step; dryrun_multichip part 2 runs the same program
 def test_seq_dp_train_step_with_dropout_runs():
     # dropout>0 training through the dp+sp step: finite loss/grads, and
     # different dropout keys give different grads (dropout really applies)

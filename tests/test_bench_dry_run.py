@@ -34,7 +34,7 @@ def test_registry_covers_every_row():
     a row cannot exist in one mode and be silently skipped by the
     other."""
     names = [n for n, _ in bench._bench_rows()]
-    assert len(names) == len(set(names)) == 29
+    assert len(names) == len(set(names)) == 31
     for must in ("cifar10_resnet9_fed_rounds_per_sec",
                  "cifar10_resnet9_per_worker_sketch_ab",
                  "gpt2_fetchsgd_per_worker_sketch_ab",
@@ -58,7 +58,9 @@ def test_registry_covers_every_row():
                  "gpt2_decode_speculative_tokens_per_sec_ab",
                  "gpt2_decode_speculative_topk_stochastic_ab",
                  "gpt2_decode_speculative_personalized_ab",
-                 "serve_personalized_admission_overhead"):
+                 "serve_personalized_admission_overhead",
+                 "gpt2_decode_tp_tokens_per_sec_ab",
+                 "serve_disagg_decode_latency_ab"):
         assert must in names
 
 

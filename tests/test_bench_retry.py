@@ -197,6 +197,19 @@ def test_main_emits_json_and_exits_zero_despite_failed_metrics(
                       "eviction_restore_ms": 1.7, "prefill_ms": 30.0,
                       "overhead_vs_prefill_pct": 5.0,
                       "k": 256, "d": 124_000_000, "n_users": 16})
+    monkeypatch.setattr(
+        bench, "bench_decode_tp_ab",
+        lambda **kw: (0.99, {"tp1_tokens_per_sec_b64": 50_000.0,
+                             "tp2_tokens_per_sec_b64": 49_500.0,
+                             "users_per_fleet_at_fixed_hbm_x_b64_tp2":
+                                 4.2}))
+    monkeypatch.setattr(
+        bench, "bench_serve_disagg_latency",
+        lambda **kw: (3.5, {"unified_decode_step_p99_ms": 70.0,
+                            "disagg_decode_step_p99_ms": 20.0,
+                            "unified_decode_step_p50_ms": 5.0,
+                            "disagg_decode_step_p50_ms": 5.2,
+                            "prefill_slots": 2}))
 
     def dead(*a, **k):
         raise RuntimeError("UNAVAILABLE: tunnel read body")
@@ -228,6 +241,8 @@ def test_main_emits_json_and_exits_zero_despite_failed_metrics(
     assert "gpt2_decode_speculative_topk_stochastic_ab" in metrics
     assert "gpt2_decode_speculative_personalized_ab" in metrics
     assert "serve_personalized_admission_overhead" in metrics
+    assert "gpt2_decode_tp_tokens_per_sec_ab" in metrics
+    assert "serve_disagg_decode_latency_ab" in metrics
     # the dead metrics are absent from the numbers but present in errors
     assert "gpt2_fetchsgd_sketch_rounds_per_sec" not in metrics
     failed = {e["metric"] for e in out["errors"]}

@@ -279,6 +279,8 @@ def test_openai_gpt_arch():
     assert any(k.startswith("LayerNorm") for k in p2)
 
 
+@pytest.mark.slow  # ~95s on a 1-core CPU box: full CLI train run —
+# the gpt2 CLI path stays covered tier-1 by test_gpt2_entrypoint_learns
 def test_openai_gpt_cli_smoke(tmp_path):
     from commefficient_tpu.training.gpt2 import main
     rc = main(["--test", "--model", "openai-gpt",

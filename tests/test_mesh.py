@@ -112,6 +112,8 @@ def _gpt2_fed_problem(T=16, W=2, B=2):
     return _Wrap(), loss, sample_in, batch, mask
 
 
+@pytest.mark.slow  # ~9s compile on 1-core CPU; the clients x model mesh
+# round runs end-to-end in __graft_entry__.dryrun_multichip part 4
 def test_clients_x_model_mesh_matches_single_device():
     # 2D federation (round-2 verdict gap #3): the client vmap runs over a
     # model axis carrying the Megatron TP layout; weights/state rows are

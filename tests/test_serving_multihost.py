@@ -1,0 +1,319 @@
+"""Multi-host serving: TP decode, owner-affinity routing, prefill/decode
+disaggregation.
+
+The anchors:
+
+* owner-affinity routing — a ``submit(user_id=...)`` lands in the slot
+  pool of the shard OWNING that user's personalization row
+  (HostArenaStore.owner), its O(k) row reads/writes never touch another
+  shard, and a full owner pool makes the request WAIT rather than
+  migrate; anonymous requests spill into any free slot so affinity
+  never idles capacity;
+* drain()/re-submit round-trips the routing: leftovers carry the
+  user_id, a fresh server reproduces the exact greedy replies;
+* disaggregation — the decode pool steps before any admission and
+  prefill dispatches are budgeted at ``prefill_slots`` per step, with
+  replies BITWISE equal to the unified server's (the handoff is a page
+  table row write; per-row greedy decode is admission-order blind);
+* config refusals for --serve_tp / --serve_disagg are loud;
+* the ``serve_multihost`` graft audit passes on the tp=2 paged step at
+  HEAD and FAILS on the replicated-pool mutation (what makes the pass
+  meaningful);
+* tp=2 greedy replies are token-identical to tp=1 (slow here at one
+  batch shape; the full fixed/paged/personalized/speculative matrix is
+  __graft_entry__.dryrun_multichip part 10).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from commefficient_tpu.serving import (ContinuousBatchingServer,
+                                       PersonalizationIndex)
+
+
+@pytest.fixture(scope="module")
+def tiny(serving_tiny_engine):
+    # the session engine shared with test_paged_serving/test_speculative:
+    # same jit caches, so the slots-8/prefill-32 programs those suites
+    # compiled stay warm here
+    return serving_tiny_engine
+
+
+def _prompts(tok, n):
+    texts = ["hello there", "do you like fish", "the weather is nice",
+             "tell me a story", "what is your name", "where are you from",
+             "sing me a song", "how old are you"][:n]
+    return [(tok.encode(t), [1] * len(tok.encode(t))) for t in texts]
+
+
+def _sharded_store(params, num_shards=2, num_clients=4):
+    from jax.flatten_util import ravel_pytree
+
+    from commefficient_tpu.config import FedConfig
+    from commefficient_tpu.federated.client_store import (HostArenaStore,
+                                                          make_codec)
+    flat, _ = ravel_pytree(params)
+    cfg = FedConfig(mode="local_topk", error_type="local",
+                    client_state="sparse", k=4,
+                    num_clients=num_clients).finalize(flat.shape[0])
+    return HostArenaStore(cfg, make_codec(cfg), num_shards=num_shards)
+
+
+# ---------------------------------------------------------------------------
+# owner-affinity routing
+# ---------------------------------------------------------------------------
+
+def test_owner_affinity_slots_and_store_isolation(tiny):
+    """user 0 (owner shard 0) decodes in shard 0's slot range, user 3
+    (owner shard 1) in shard 1's, and each admission's store row I/O
+    lands ONLY on the owner shard's counters."""
+    tok, model, params, engine = tiny
+    store = _sharded_store(engine.params)       # 4 users over 2 shards
+    assert (store.owner(0), store.owner(3)) == (0, 1)
+    srv = ContinuousBatchingServer(
+        engine, slots=8, prefill_len=32, kv_cache="paged",
+        personalize=PersonalizationIndex(engine.params, store))
+    assert srv.num_shards == 2 and srv.slots_per_shard == 4
+    p = _prompts(tok, 3)
+    r0 = srv.submit(*p[0], reply_type=1, max_new=6, user_id=0)
+    r3 = srv.submit(*p[1], reply_type=1, max_new=6, user_id=3)
+    ra = srv.submit(*p[2], reply_type=1, max_new=6)  # anonymous
+    srv.step()
+    slot_of = {req.rid: s for s, req in enumerate(srv._slot_req)
+               if req is not None}
+    assert 0 <= slot_of[r0] < 4                 # shard 0's pool
+    assert 4 <= slot_of[r3] < 8                 # shard 1's pool
+    st = srv.stats()
+    assert st["num_shards"] == 2 and st["slots_per_shard"] == 4
+    assert st["admitted_per_shard"][0] >= 1
+    assert st["admitted_per_shard"][1] >= 1
+    # row I/O stayed on the owners: both shards saw exactly their own
+    # user's admission read, nothing crossed
+    reads = st["store_shard_reads"]
+    assert reads[0] >= 1 and reads[1] >= 1
+    replies = srv.run()
+    assert set(replies) == {r0, r3, ra}
+    # zero deltas: routing must not perturb the greedy stream
+    for (ids, types), rid in zip(p, (r0, r3, ra)):
+        solo = engine.generate([(ids, types)], [types[-1]], max_new=6)[0]
+        assert replies[rid] == solo
+
+
+def test_personalized_waits_for_owner_anonymous_spills(tiny):
+    """A personalized request whose owner pool is full WAITS (its row
+    never crosses shards) while an anonymous request spills into the
+    other shard's free slot — and the release that frees the owner pool
+    admits the waiter before any anonymous work steals it."""
+    tok, model, params, engine = tiny
+    store = _sharded_store(engine.params)
+    srv = ContinuousBatchingServer(
+        engine, slots=2, prefill_len=32, kv_cache="paged",
+        personalize=PersonalizationIndex(engine.params, store))
+    assert srv.slots_per_shard == 1
+    p = _prompts(tok, 4)
+    r_hold = srv.submit(*p[0], reply_type=1, max_new=8, user_id=0)
+    srv.step()                                  # user 0 holds shard 0
+    r_wait = srv.submit(*p[1], reply_type=1, max_new=2, user_id=1)
+    r_anon = srv.submit(*p[2], reply_type=1, max_new=6)
+    srv.step()
+    # the waiter is still queued on shard 0; the anonymous request
+    # spilled into shard 1's slot
+    assert [r.rid for r in srv._shard_queue[0]] == [r_wait]
+    assert srv._slot_req[1] is not None and \
+        srv._slot_req[1].rid == r_anon
+    st = srv.stats()
+    assert st["spilled_per_shard"] == [0, 1]
+    replies = srv.run()
+    assert set(replies) == {r_hold, r_wait, r_anon}
+    assert srv.stats()["admitted_per_shard"][0] == 2  # hold + waiter
+
+
+def test_drain_leftovers_carry_user_id_and_replay_bitwise(tiny):
+    """drain() hands back unadmitted personalized requests WITH their
+    user_id so a replacement server routes them to the same owner
+    shard; replaying the leftovers reproduces the exact greedy
+    replies."""
+    tok, model, params, engine = tiny
+    store = _sharded_store(engine.params)
+    srv = ContinuousBatchingServer(
+        engine, slots=2, prefill_len=32, kv_cache="paged",
+        personalize=PersonalizationIndex(engine.params, store))
+    p = _prompts(tok, 6)
+    budgets = [5, 3, 4, 2, 6, 3]
+    rids = [srv.submit(*p[i], reply_type=1, max_new=budgets[i],
+                       user_id=(i % 4 if i < 4 else None))
+            for i in range(6)]
+    srv.step()                                  # 2 admitted, 4 queued
+    replies, leftovers = srv.drain()
+    assert len(replies) + len(leftovers) == 6
+    assert any(len(left) == 5 for left in leftovers)   # user_id rides
+    fresh = ContinuousBatchingServer(
+        engine, slots=2, prefill_len=32, kv_cache="paged",
+        personalize=PersonalizationIndex(engine.params,
+                                         _sharded_store(engine.params)))
+    new_rids = [fresh.submit(*left) for left in leftovers]
+    replies2 = fresh.run()
+    got = sorted(map(tuple, list(replies.values())
+                 + [replies2[r] for r in new_rids]))
+    solos = sorted(tuple(engine.generate([p[i]], [p[i][1][-1]],
+                                         max_new=budgets[i])[0])
+                   for i in range(6))
+    assert got == solos
+
+
+def test_unsharded_store_keeps_single_pool_and_slot_divisibility(tiny):
+    tok, model, params, engine = tiny
+    store = _sharded_store(engine.params, num_shards=1)
+    srv = ContinuousBatchingServer(
+        engine, slots=8, prefill_len=32, kv_cache="paged",
+        personalize=PersonalizationIndex(engine.params, store))
+    assert srv.num_shards == 1 and srv.slots_per_shard == 8
+    with pytest.raises(ValueError, match="divide evenly"):
+        ContinuousBatchingServer(
+            engine, slots=3, prefill_len=32, kv_cache="paged",
+            personalize=PersonalizationIndex(engine.params,
+                                             _sharded_store(engine.params)))
+
+
+# ---------------------------------------------------------------------------
+# prefill/decode disaggregation
+# ---------------------------------------------------------------------------
+
+def test_disagg_bounded_admissions_and_reply_parity(tiny):
+    """Disaggregated steps admit at most ``prefill_slots`` requests each
+    (the decode pool's cadence never absorbs a whole burst of B=1
+    prefills), and the replies are BITWISE the unified server's — the
+    page-table handoff changes scheduling, not tokens."""
+    tok, model, params, engine = tiny
+    p = _prompts(tok, 8)
+    budgets = [6, 3, 5, 2, 7, 4, 3, 5]
+
+    def run(disagg):
+        kw = {"disaggregate": True, "prefill_slots": 2} if disagg else {}
+        srv = ContinuousBatchingServer(engine, slots=8, prefill_len=32,
+                                       kv_cache="paged", **kw)
+        rids = [srv.submit(*p[i], reply_type=1, max_new=budgets[i])
+                for i in range(8)]
+        if disagg:
+            srv.step()
+            assert sum(r is not None for r in srv._slot_req) == 2
+            srv.step()
+            assert sum(r is not None for r in srv._slot_req) <= 4
+            assert srv.stats()["disaggregated"] is True
+            assert srv.stats()["prefill_slots"] == 2
+        replies = srv.run()
+        return [replies[r] for r in rids]
+
+    assert run(True) == run(False)
+
+
+def test_disagg_validation_is_loud(tiny):
+    tok, model, params, engine = tiny
+    with pytest.raises(ValueError, match="paged"):
+        ContinuousBatchingServer(engine, slots=8, prefill_len=32,
+                                 kv_cache="fixed", disaggregate=True)
+    with pytest.raises(ValueError, match="slots"):
+        ContinuousBatchingServer(engine, slots=1, prefill_len=32,
+                                 kv_cache="paged", disaggregate=True)
+    with pytest.raises(ValueError, match="prefill_slots"):
+        ContinuousBatchingServer(engine, slots=4, prefill_len=32,
+                                 kv_cache="paged", disaggregate=True,
+                                 prefill_slots=4)
+
+
+# ---------------------------------------------------------------------------
+# config / CLI refusals
+# ---------------------------------------------------------------------------
+
+def test_serve_tp_config_refusals():
+    from commefficient_tpu.config import FedConfig
+    with pytest.raises(ValueError, match="mesh"):
+        FedConfig(serve_tp=2).finalize(1000)
+    with pytest.raises(ValueError, match="model axis"):
+        FedConfig(serve_tp=2, mesh_shape=(1, 4),
+                  mesh_axis_names=("clients", "model")).finalize(1000)
+    with pytest.raises(ValueError, match="serve_tp"):
+        FedConfig(serve_tp=2, mesh_shape=(1, 2),
+                  mesh_axis_names=("clients", "model"),
+                  kv_quant="int8",
+                  model_checkpoint="gpt2-xl").finalize(1000)  # 25 heads
+    with pytest.raises(ValueError, match="serve_slots"):
+        FedConfig(serve_disagg=True, serve_slots=1).finalize(1000)
+    # valid combos pass
+    FedConfig(serve_tp=2, mesh_shape=(1, 2),
+              mesh_axis_names=("clients", "model")).finalize(1000)
+    FedConfig(serve_disagg=True, serve_slots=8).finalize(1000)
+
+
+def test_serve_flags_parse_into_config():
+    from commefficient_tpu.training.args import args_to_config, build_parser
+    args = build_parser().parse_args(
+        ["--serve_tp", "2", "--serve_slots", "16", "--serve_disagg",
+         "--mesh", "clients=1,model=2"])
+    cfg = args_to_config(args)
+    assert cfg.serve_tp == 2
+    assert cfg.serve_slots == 16
+    assert cfg.serve_disagg is True
+
+
+# ---------------------------------------------------------------------------
+# the serve_multihost graft audit (tp=2 paged step)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.audit
+def test_serve_multihost_audit_passes_at_head():
+    from commefficient_tpu.analysis.targets import serve_multihost_target
+    rep = serve_multihost_target().audit(with_retrace=False)
+    assert rep.target == "serve_multihost/step"
+    assert rep.ok, rep
+
+
+@pytest.mark.audit
+def test_serve_multihost_audit_fails_on_replicated_pool_mutation():
+    """Re-pinning the page pools to the replicated layout (the
+    all-gather GSPMD would materialize on every shard) must FAIL the
+    sharded_pool rule — the negative control that keeps the
+    serve_multihost gate honest."""
+    from commefficient_tpu.analysis.targets import serve_multihost_target
+    rep = serve_multihost_target(mutate=True).audit(with_retrace=False)
+    assert not rep.ok
+    msgs = "\n".join(str(v) for r in rep.rule_reports
+                     for v in r.violations)
+    assert "heads not sharded" in msgs
+
+
+# ---------------------------------------------------------------------------
+# tp greedy parity (one shape here; the full mode matrix is
+# __graft_entry__.dryrun_multichip part 10)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_tp2_paged_greedy_parity_token_identical(tiny):
+    """The tp=2 head-sharded paged server emits token-identical greedy
+    replies to the replicated engine, with ONE compiled step program
+    across admissions (GSPMD compile cost is why this runs under
+    ``slow``; the acceptance matrix lives in dryrun_multichip)."""
+    from jax.sharding import Mesh
+
+    from commefficient_tpu.serving import DecodeEngine
+    tok, model, params, engine = tiny
+    assert jax.device_count() >= 2
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("model",))
+    tp_engine = DecodeEngine(model, params, eos_id=engine.eos_id,
+                             max_len=48, method="greedy", mesh=mesh)
+    assert tp_engine.tp == 2
+    p = _prompts(tok, 4)
+    budgets = [6, 3, 5, 4]
+
+    def run(eng):
+        srv = ContinuousBatchingServer(eng, slots=2, prefill_len=32,
+                                       kv_cache="paged")
+        rids = [srv.submit(*p[i], reply_type=1, max_new=budgets[i])
+                for i in range(4)]
+        replies = srv.run()
+        return [replies[r] for r in rids]
+
+    assert run(tp_engine) == run(engine)
+    assert tp_engine.paged_step._cache_size() == 1
+    assert tp_engine.paged_insert._cache_size() == 1
