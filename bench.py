@@ -2167,6 +2167,262 @@ def bench_serve_disagg_latency(B=8, prompt_len=128, new_tokens=64,
     return round(p99s["unified"] / max(p99s["disagg"], 1e-9), 4), breakdown
 
 
+def _perturbed_params(params, eps, seed):
+    """Deterministic shape/dtype-preserving weight perturbation — the
+    stand-in for 'the learner trained for a while' in the online rows
+    (a per-leaf sinusoid so no PRNG threading is needed)."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree.map(
+        lambda x: (x + eps * jnp.sin(
+            jnp.arange(x.size, dtype=jnp.float32) + float(seed)
+        ).reshape(x.shape).astype(x.dtype)).astype(x.dtype), params)
+
+
+def bench_online_swap_latency(n_swaps=6, B=8, prompt_len=128,
+                              new_tokens=64, page_size=16, queued=8):
+    """--serve_online hot-swap latency: the wall time a running paged
+    server spends promoting fresh base weights through
+    HotSwapCoordinator — drain the in-flight slots to completion, place
+    the new gpt2-small leaves onto the old leaves' shardings, resubmit
+    the never-admitted queue verbatim, take the first post-swap step.
+    ``n_swaps`` back-to-back swaps of pre-built perturbed weights with
+    the request stream kept flowing between them. The compile-cache
+    assertion is the row's hard contract: the paged step AND pack
+    caches must sit at exactly their pre-swap sizes after every swap
+    (params are per-call arguments everywhere, so a growing cache means
+    a recompile leaked into the swap path — the online_loop audit pins
+    the same invariant at audit scale).
+
+    Dry-run runs the REAL contract at tiny scale (like the
+    personalization row): a live tiny server mid-decode, two coordinator
+    swaps of perturbed weights through drain -> swap -> resubmit, the
+    caches asserted flat, zero dirty swaps, and the admitted work's
+    replies delivered by the drain rather than thrown away.
+
+    Returns (median swap-to-serving ms, breakdown with p50/p99,
+    drained/resubmitted counts and the pinned cache sizes)."""
+    import jax
+    import jax.numpy as jnp
+
+    from commefficient_tpu.models.gpt2 import GPT2Config, GPT2DoubleHeads
+    from commefficient_tpu.online import HotSwapCoordinator
+    from commefficient_tpu.serving import (ContinuousBatchingServer,
+                                           DecodeEngine)
+
+    rng = np.random.RandomState(0)
+
+    if DRY_RUN:
+        V = 256
+        model = GPT2DoubleHeads(GPT2Config.tiny(vocab_size=V))
+        z = np.zeros((1, 1, 8), np.int32)
+        params = model.init(jax.random.PRNGKey(0), z, z,
+                            np.zeros((1, 1), np.int32),
+                            train=False)["params"]
+        engine = DecodeEngine(model, params, eos_id=V - 1, max_len=32,
+                              method="greedy")
+        srv = ContinuousBatchingServer(engine, slots=2, prefill_len=16,
+                                       kv_cache="paged", page_size=8)
+        coord = HotSwapCoordinator(srv, resubmit=True)
+        for i in range(6):                      # 2 admitted + 4 queued
+            ids = rng.randint(0, V - 1, 6 + i).astype(np.int32).tolist()
+            srv.submit(ids, [1] * len(ids), 1, 8)
+        srv.step()
+        caches = (engine.paged_step._cache_size(),
+                  engine.paged_insert._cache_size())
+        drained = 0
+        for k in range(2):
+            # a swap must find slots mid-decode or it prices nothing
+            while not any(r is not None for r in srv._slot_req):
+                srv.step()
+            replies, _ = coord.swap(_perturbed_params(params, 0.01, k))
+            drained += len(replies)
+            srv.step()                          # serve on the new weights
+        after = (engine.paged_step._cache_size(),
+                 engine.paged_insert._cache_size())
+        assert after == caches, \
+            f"compile cache grew across hot swaps: {caches} -> {after}"
+        assert srv.dirty_swaps == 0 and coord.swaps_done == 2
+        assert drained >= 2, "drain delivered no in-flight replies"
+        srv.run()
+        return {"dry_run": "ok", "caches": list(caches),
+                "drained": drained}, {}
+
+    P, N = prompt_len, new_tokens
+    S = P + N
+    gcfg = GPT2Config.small(vocab_size=50262)
+    gcfg.n_positions = max(gcfg.n_positions, S)
+    gcfg.dropout = 0.0
+    gcfg.dtype = "bfloat16"
+    model = GPT2DoubleHeads(gcfg)
+    key = jax.random.PRNGKey(0)
+    sample_in = (jnp.zeros((1, 1, 8), jnp.int32),
+                 jnp.zeros((1, 1, 8), jnp.int32),
+                 jnp.zeros((1, 1), jnp.int32))
+    params = model.init(key, *sample_in, train=False)["params"]
+    engine = DecodeEngine(model, params, eos_id=50261, max_len=S,
+                          method="greedy")
+    srv = ContinuousBatchingServer(engine, slots=B, prefill_len=P,
+                                   kv_cache="paged", page_size=page_size)
+    coord = HotSwapCoordinator(srv, resubmit=True)
+
+    def prompt():
+        L = int(rng.randint(P // 2, P + 1))
+        return (rng.randint(0, 50000, L).astype(np.int32).tolist(),
+                [1] * L)
+
+    for _ in range(B):                          # compile every program
+        srv.submit(*prompt(), 1, 4)
+    srv.run()
+    swaps = [_perturbed_params(params, 0.01, k) for k in range(n_swaps)]
+    for s in swaps:                             # build OUTSIDE the clock
+        _sync(jax.tree.leaves(s)[0])
+    caches = (engine.paged_step._cache_size(),
+              engine.paged_insert._cache_size())
+
+    lat, drained, resubmitted = [], 0, 0
+    for k in range(n_swaps):
+        for _ in range(B + queued):             # in-flight + queued load
+            srv.submit(*prompt(), 1, N)
+        for _ in range(4):                      # slots mid-decode
+            srv.step()
+        t0 = time.perf_counter()
+        replies, leftovers = coord.swap(swaps[k])
+        srv.step()                              # first post-swap step
+        lat.append((time.perf_counter() - t0) * 1e3)
+        drained += len(replies)
+        resubmitted += len(leftovers)
+        srv.run()                               # clear between swaps
+    after = (engine.paged_step._cache_size(),
+             engine.paged_insert._cache_size())
+    assert after == caches, \
+        f"compile cache grew across hot swaps: {caches} -> {after}"
+    assert srv.dirty_swaps == 0
+    p50, p99 = np.percentile(np.asarray(lat), [50, 99])
+    return round(float(p50), 2), {
+        "swap_to_serving_p50_ms": round(float(p50), 2),
+        "swap_to_serving_p99_ms": round(float(p99), 2),
+        "n_swaps": n_swaps, "slots": B, "queued_per_swap": queued,
+        "drained_total": drained, "resubmitted_total": resubmitted,
+        "dirty_swaps": srv.dirty_swaps,
+        "paged_step_cache": after[0], "paged_insert_cache": after[1],
+    }
+
+
+def bench_online_acceptance_drift_ab(gamma=4, B=8, prompt_len=64,
+                                     new_tokens=48, page_size=16,
+                                     eps=(0.005, 0.02, 0.08)):
+    """--serve_online x --speculate_k: how fast online training strands
+    a pinned drafter. The server self-drafts (drafter snapshot == the
+    target at t=0, so greedy acceptance is 1.0 by construction), then
+    the coordinator hot-swaps progressively perturbed target weights
+    while the drafter keeps its pre-swap snapshot — the online loop's
+    deployment shape, where the drafter is NOT retrained every swap.
+    ``stats()['acceptance_rate_since_swap']`` (the window
+    swap_base_params resets) is the drift signal: post-swap over
+    pre-swap acceptance is the fraction of the speculative win each
+    swap keeps before the drafter is refreshed.
+
+    Dry-run runs the REAL counter-reset contract at tiny scale: a live
+    self-drafting speculative server accumulates drafted_since_swap, a
+    drained coordinator swap must zero the window (rate None, counts 0)
+    while the lifetime totals survive, and post-swap traffic must
+    re-accumulate into the fresh window.
+
+    Returns (post-swap acceptance at the largest perturbation /
+    pre-swap acceptance, breakdown with the per-eps trajectory)."""
+    import jax
+    import jax.numpy as jnp
+
+    from commefficient_tpu.models.gpt2 import GPT2Config, GPT2DoubleHeads
+    from commefficient_tpu.online import HotSwapCoordinator
+    from commefficient_tpu.serving import (ContinuousBatchingServer,
+                                           DecodeEngine)
+
+    rng = np.random.RandomState(0)
+
+    if DRY_RUN:
+        V = 256
+        model = GPT2DoubleHeads(GPT2Config.tiny(vocab_size=V))
+        z = np.zeros((1, 1, 8), np.int32)
+        params = model.init(jax.random.PRNGKey(0), z, z,
+                            np.zeros((1, 1), np.int32),
+                            train=False)["params"]
+        engine = DecodeEngine(model, params, eos_id=V - 1, max_len=32,
+                              method="greedy")
+        srv = ContinuousBatchingServer(engine, slots=2, prefill_len=16,
+                                       kv_cache="paged", page_size=8,
+                                       speculate_k=2, drafter_model=model,
+                                       drafter_params=params)
+        coord = HotSwapCoordinator(srv, resubmit=True)
+
+        def pump(n):
+            for i in range(n):
+                ids = rng.randint(0, V - 1, 6 + i).astype(
+                    np.int32).tolist()
+                srv.submit(ids, [1] * len(ids), 1, 8)
+            srv.run()
+
+        pump(3)
+        st = srv.stats()
+        assert st["drafted_since_swap"] > 0
+        lifetime = st["drafted"]
+        coord.swap(_perturbed_params(params, 0.05, 0))
+        st = srv.stats()                        # the mark reset itself
+        assert st["drafted_since_swap"] == 0
+        assert st["accepted_since_swap"] == 0
+        assert st["acceptance_rate_since_swap"] is None
+        assert st["drafted"] == lifetime        # totals survive the swap
+        pump(3)
+        st = srv.stats()
+        assert st["drafted_since_swap"] > 0     # fresh window fills
+        return {"dry_run": "ok",
+                "drafted_since_swap": st["drafted_since_swap"]}, {}
+
+    P, N = prompt_len, new_tokens
+    S = P + N
+    V = 50262
+    gcfg = GPT2Config.small(vocab_size=V)
+    gcfg.n_positions = max(gcfg.n_positions, S)
+    gcfg.dropout = 0.0
+    gcfg.dtype = "bfloat16"
+    model = GPT2DoubleHeads(gcfg)
+    key = jax.random.PRNGKey(0)
+    sample_in = (jnp.zeros((1, 1, 8), jnp.int32),
+                 jnp.zeros((1, 1, 8), jnp.int32),
+                 jnp.zeros((1, 1), jnp.int32))
+    params = model.init(key, *sample_in, train=False)["params"]
+    engine = DecodeEngine(model, params, eos_id=V - 1, max_len=S,
+                          method="greedy")
+    srv = ContinuousBatchingServer(engine, slots=B, prefill_len=P,
+                                   kv_cache="paged", page_size=page_size,
+                                   speculate_k=gamma, drafter_model=model,
+                                   drafter_params=params)
+    coord = HotSwapCoordinator(srv, resubmit=True)
+
+    def pump():
+        for _ in range(2 * B):
+            L = int(rng.randint(P // 2, P + 1))
+            srv.submit(rng.randint(0, 50000, L).astype(np.int32).tolist(),
+                       [1] * L, 1, N)
+        srv.run()
+
+    pump()
+    acc0 = srv.stats()["acceptance_rate_since_swap"]
+    breakdown = {"gamma": gamma, "slots": B, "eps": list(eps),
+                 "acceptance_pre_swap": round(acc0, 4)}
+    acc = acc0
+    for k, e in enumerate(eps):
+        # each arm perturbs the ORIGINAL snapshot by eps, so the
+        # trajectory is drift-vs-distance, not compounding noise
+        coord.swap(_perturbed_params(params, e, k))
+        pump()
+        acc = srv.stats()["acceptance_rate_since_swap"]
+        breakdown[f"acceptance_since_swap_eps{e}"] = round(acc, 4)
+    return round(acc / max(acc0, 1e-9), 4), breakdown
+
+
 def _bench_rows():
     """Every bench row, as (name, zero-arg closure) pairs — the single
     registry both the timed JSON path and ``--dry-run`` iterate, so a row
@@ -2244,6 +2500,10 @@ def _bench_rows():
          lambda: bench_decode_tp_ab()),
         ("serve_disagg_decode_latency_ab",
          lambda: bench_serve_disagg_latency()),
+        ("gpt2_online_swap_latency",
+         lambda: bench_online_swap_latency()),
+        ("gpt2_online_acceptance_drift_ab",
+         lambda: bench_online_acceptance_drift_ab()),
     ]
 
 
@@ -2254,7 +2514,8 @@ def _bench_rows():
 ROW_PRESETS = {
     "serving_column": ("gpt2_decode_tokens_per_sec_chip_*",
                        "*decode_paged*", "*speculative*",
-                       "*personalized*", "*decode_tp*", "*disagg*"),
+                       "*personalized*", "*decode_tp*", "*disagg*",
+                       "*online*"),
 }
 
 
@@ -2578,6 +2839,30 @@ def main():
                     "priced against the B=1 prefill admission already "
                     "pays; eviction restores base bitwise"})
         if pers is not None else None)
+    oswap = res["gpt2_online_swap_latency"]
+    add("gpt2_online_swap_latency",
+        round(oswap[0], 2) if oswap is not None else None, "ms",
+        dict(oswap[1], **{
+            "note": "--serve_online hot swap: drain the in-flight slots "
+                    "to completion, place fresh gpt2-small weights onto "
+                    "the old leaves' shardings, resubmit the queue "
+                    "verbatim, first post-swap step — median "
+                    "swap-to-serving wall time; the paged step/pack "
+                    "compile caches are asserted flat across every swap "
+                    "(the online_loop audit pins the same invariant)"})
+        if oswap is not None else None)
+    odrift = res["gpt2_online_acceptance_drift_ab"]
+    add("gpt2_online_acceptance_drift_ab",
+        round(odrift[0], 4) if odrift is not None else None, "ratio",
+        dict(odrift[1], **{
+            "note": "--serve_online x --speculate_k: the self-drafting "
+                    "acceptance window (acceptance_rate_since_swap, "
+                    "reset by swap_base_params) before vs after "
+                    "hot-swapping perturbed target weights over a "
+                    "pinned drafter snapshot — the per-swap cost of NOT "
+                    "retraining the drafter, the signal the online loop "
+                    "would key a drafter refresh on"})
+        if odrift is not None else None)
 
     # always ONE JSON line and exit 0 — partial numbers beat no artifact;
     # consumers check "errors" for what (if anything) went missing

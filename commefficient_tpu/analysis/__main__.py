@@ -28,7 +28,7 @@ def main(argv=None) -> int:
                                  "attention", "sketch", "decode",
                                  "decode_paged", "decode_paged_quant",
                                  "decode_speculative", "serve_multihost",
-                                 "all"])
+                                 "online_loop", "all"])
     parser.add_argument("--no-retrace", action="store_true",
                         help="skip the (compile-heavy) retrace guards")
     parser.add_argument("--prng-lint", action="store_true",

@@ -1031,6 +1031,187 @@ def serve_multihost_target(mutate: bool = False) -> AuditTarget:
 
 
 # --------------------------------------------------------------------------
+# train-while-serve online loop
+# --------------------------------------------------------------------------
+
+def online_loop_target(mutate: bool = False) -> AuditTarget:
+    """The train-while-serve cycle (commefficient_tpu/online/).
+
+    The audited PROGRAM is the buffered lock-step cohort over
+    collector-built batches — the exact jit ``OnlineLoop`` dispatches
+    between decode steps — under the STRICT ``(num_clients, d)`` ban:
+    online client state is sparse-encoded ``(num_clients, O(k))``
+    arenas read through ``LearnerClientStore``, so a dense client
+    matrix anywhere in the cohort program is the densification the
+    subsystem exists to avoid (no writeback allowlist applies; the
+    sparse round has no legitimate n-leading eqn at all).
+
+    The retrace guard drives the REAL cycle end to end: synthetic
+    per-user traffic through a paged personalized server, finished
+    replies collected into cohorts, lock-step applies, and >= 2 hot
+    swaps through ``HotSwapCoordinator`` — asserting that
+
+    * the paged step AND pack programs never grow past ONE compiled
+      variant across every swap (swap_base_params re-places leaves
+      onto the old shardings/commitment; params cross every serving
+      jit as traced arguments, with personalization admit/evict churn
+      in between), and
+    * every swap was CLEAN (``server.dirty_swaps == 0`` — the drain
+      ran first, so every reply finished under its admission-time
+      weights; tests/test_online.py pins that parity bitwise).
+
+    ``mutate=True`` keeps the same build but fires one
+    ``coordinator.swap(..., force=True)`` while a slot is verifiably
+    mid-decode — the skip-the-drain bug — and the audit must FAIL on
+    it (tests/test_online.py pins this): the forced swap surfaces as
+    ``dirty_swaps > 0``.
+    """
+    from commefficient_tpu.config import FedConfig
+    from commefficient_tpu.federated.buffer import BufferedFedLearner
+    from commefficient_tpu.federated.losses import (make_gpt2_train_loss,
+                                                    make_gpt2_val_loss)
+    from commefficient_tpu.models.gpt2 import GPT2Config, GPT2DoubleHeads
+    from commefficient_tpu.online import (HotSwapCoordinator,
+                                          InteractionCollector,
+                                          LearnerClientStore, OnlineLoop)
+    from commefficient_tpu.serving import (ContinuousBatchingServer,
+                                           DecodeEngine)
+    from commefficient_tpu.serving.personalize import PersonalizationIndex
+
+    n_clients, W, B, S, V = 6, 2, 2, 32, 300
+    eos = V - 1
+    model = GPT2DoubleHeads(GPT2Config.tiny(vocab_size=V))
+
+    class _Wrap:
+        def init(self, rng, s, train):
+            return model.init(rng, *s, train=train)
+
+        def apply(self, *a, **k):
+            return model.apply(*a, **k)
+
+    # lr small enough that training does NOT collapse replies to an
+    # immediate eos (every collected example carries an eos-labeled
+    # tail): probes must keep decoding across swap boundaries for the
+    # parity/dirty checks to have anything to straddle
+    cfg = FedConfig(mode="local_topk", error_type="local",
+                    local_momentum=0.9, k=16, client_state="sparse",
+                    weight_decay=0, num_workers=W, num_clients=n_clients,
+                    lr_scale=0.05, server_mode="buffered")
+    collector = InteractionCollector(n_clients, S, num_candidates=1,
+                                     eos_id=eos)
+    sample = collector.sample_batch()
+    ln = BufferedFedLearner(_Wrap(), cfg, make_gpt2_train_loss(model, 1., 1.),
+                            make_gpt2_val_loss(model), jax.random.PRNGKey(5),
+                            (sample[0], sample[4], sample[1]))
+    d = int(ln.state.last_changed.shape[0])
+    # all-padding cohort at the collector's exact shapes (shape source
+    # only, like the learner's init sample)
+    ids0, cols0, mask0 = InteractionCollector(
+        n_clients, S, num_candidates=1, eos_id=eos).sample_round(W, B)
+
+    def trace():
+        return jax.make_jaxpr(ln._lockstep.raw)(
+            ln.state, jnp.asarray(ids0),
+            tuple(jnp.asarray(c) for c in cols0), jnp.asarray(mask0),
+            jnp.float32(0.05), jax.random.PRNGKey(0))
+
+    def retrace():
+        from .rules import Violation
+        engine = DecodeEngine(model, ln.params, eos_id=eos, max_len=S,
+                              method="greedy")
+        store = LearnerClientStore(ln)
+        collector.store = store
+        srv = ContinuousBatchingServer(
+            engine, slots=4, prefill_len=S, kv_cache="paged",
+            personalize=PersonalizationIndex(engine.params, store))
+        coord = HotSwapCoordinator(srv, ln, resubmit=False)
+        loop = OnlineLoop(srv, collector, ln, coord, train_every=2,
+                          swap_every=1, num_workers=W, local_batch_size=B,
+                          max_new=4)
+        rs = np.random.RandomState(41)
+        forced = [0]
+
+        def feed():
+            while loop.inflight() < srv.slots:
+                pl = int(rs.randint(3, 8))
+                gold = [int(t) for t in
+                        rs.randint(0, V - 1, int(rs.randint(3, 6)))]
+                loop.submit([int(t) for t in rs.randint(0, V - 1, pl)],
+                            [7] * pl, 7, max_new=len(gold),
+                            user_id=int(rs.randint(0, n_clients)),
+                            label_ids=gold)
+
+        def drive(i):
+            # each call lands (at least) one more CLEAN swap: traffic in,
+            # replies collected, cohorts trained, coordinator swap
+            target = loop.swaps + 1
+            for _ in range(80):
+                feed()
+                loop.step()
+                if loop.swaps >= target:
+                    break
+            if mutate and i == 2 and not forced[0]:
+                # the deliberate bug: swap under ACTIVE slots. Pump the
+                # server directly (srv.step never swaps, unlike
+                # loop.step) until a slot is verifiably mid-decode, then
+                # skip the drain.
+                feed()
+                for _ in range(20):
+                    loop._record_finished(srv.step())
+                    if any(r is not None for r in srv._slot_req):
+                        break
+                coord.swap(jax.tree.map(
+                    lambda x: x + 0.1 * jnp.sin(
+                        jnp.arange(x.size, dtype=jnp.float32)
+                    ).reshape(x.shape).astype(x.dtype), ln.params),
+                    force=True)
+                forced[0] = 1
+
+        report = check_retrace(engine.paged_step, None, repeats=3,
+                               warmup=1, drive=drive)
+
+        def flag(msg):
+            report.ok = False
+            report.violations.append(Violation(
+                rule="retrace", path="", primitive="jit", message=msg))
+
+        pack = engine.paged_insert._cache_size()
+        dirty = int(srv.dirty_swaps)
+        if pack > 1:
+            flag(f"paged pack program compiled {pack} variants across "
+                 f"{loop.swaps} swaps — the swap leaked a new call "
+                 f"signature (sharding/commitment drift)")
+        if loop.swaps < 2:
+            flag(f"drive landed only {loop.swaps} clean swaps — the "
+                 f"audit never exercised the swap boundary")
+        if dirty:
+            flag(f"{dirty} dirty swap(s): weights moved under active "
+                 f"slots — the drain-before-swap contract was skipped")
+        report.notes += (f"; {loop.swaps} clean swaps, {dirty} dirty, "
+                         f"{loop.rounds_done} cohorts over "
+                         f"{collector.collected} collected interactions, "
+                         f"pack cache {pack}")
+        return report
+
+    strict = ShapePattern(("num_clients", "d"),
+                          label="dense client matrix",
+                          allow_primitives=frozenset())
+    return AuditTarget(
+        name="online_loop/cycle" + ("(mutated)" if mutate else ""),
+        description="train-while-serve cohort over collector batches; "
+                    "strict no-(num_clients, d) ban; retrace drives the "
+                    "real serve->collect->train->swap cycle, caches at "
+                    "one program, every swap drained-before-swapped"
+                    + (" [forced dirty-swap mutation — must fail]"
+                       if mutate else ""),
+        trace=trace,
+        dims={"num_clients": n_clients, "d": d},
+        rules=(FootprintRule((strict,) + DEFAULT_PATTERNS[1:]),
+               TransferRule()),
+        retrace=retrace)
+
+
+# --------------------------------------------------------------------------
 # sketch ops
 # --------------------------------------------------------------------------
 
@@ -1106,6 +1287,8 @@ def build_targets(name: str) -> list:
         return [serve_multihost_target()]
     if name == "client_store":
         return [client_store_target()]
+    if name == "online_loop":
+        return [online_loop_target()]
     if name == "all":
         return (build_targets("round") + build_targets("round_bucketed")
                 + build_targets("sketch_batched")
@@ -1115,8 +1298,9 @@ def build_targets(name: str) -> list:
                 + build_targets("decode_paged")
                 + build_targets("decode_speculative")
                 + build_targets("decode_paged_quant")
-                + build_targets("serve_multihost"))
+                + build_targets("serve_multihost")
+                + build_targets("online_loop"))
     raise ValueError(f"unknown audit target {name!r} (round|round_bucketed|"
                      f"sketch_batched|buffered|client_store|gpt2|attention|"
                      f"sketch|decode|decode_paged|decode_speculative|"
-                     f"decode_paged_quant|serve_multihost|all)")
+                     f"decode_paged_quant|serve_multihost|online_loop|all)")

@@ -170,6 +170,20 @@ class FedConfig:
     # (the handoff between pools is a page-table row write) and at
     # least 2 slots (one per pool).
     serve_disagg: bool = False
+    # Train-while-serve (commefficient_tpu/online/): close the loop in
+    # one process — the continuous-batching server collects per-user
+    # interactions, buffered federated cohorts train against the SAME
+    # sparse client rows serving reads as personalization deltas, and
+    # refreshed base weights hot-swap into the live server
+    # (drain -> fingerprint gate -> swap -> resubmit leftovers).
+    # Requires server_mode='buffered' (the externally-steppable host
+    # event loop) and serve_personalized (hence client_state='sparse').
+    serve_online: bool = False
+    # Online cadences: dispatch one buffered cohort every
+    # online_train_every served interactions, and attempt a hot swap
+    # every online_swap_every applies.
+    online_train_every: int = 4
+    online_swap_every: int = 2
     # Offload pipeline depth (api.HostOffloadPipeline): how many rounds of
     # output rows may sit in the lazy-writeback queue while their (W, d)
     # device buffers stay alive. 2 = double buffering (gather round t+1 /
@@ -332,6 +346,24 @@ class FedConfig:
                 f"--serve_disagg splits serving into prefill and decode "
                 f"slot pools; --serve_slots {self.serve_slots} < 2 "
                 f"cannot hold both pools")
+        if self.serve_online:
+            if self.server_mode != "buffered":
+                raise ValueError(
+                    "--serve_online interleaves federated cohorts with "
+                    "decode steps on the buffered host event loop "
+                    "(federated/buffer.py pump_events); run with "
+                    "--server_mode buffered")
+            if not self.serve_personalized:
+                raise ValueError(
+                    "--serve_online trains the sparse client rows the "
+                    "server reads as per-user deltas — without "
+                    "--serve_personalized (and --client_state sparse) "
+                    "there is nothing for live traffic to personalize")
+        if self.online_train_every < 1 or self.online_swap_every < 1:
+            raise ValueError(
+                f"online cadences must be >= 1, got online_train_every="
+                f"{self.online_train_every}, online_swap_every="
+                f"{self.online_swap_every}")
         if self.client_state == "sketched":
             if self.error_type != "local":
                 raise ValueError(

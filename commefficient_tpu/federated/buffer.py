@@ -41,8 +41,13 @@ contribution is excluded at apply (jnp.where — NaN * 0 is NaN) and its
 client benched for quarantine_rounds applies; only a post-exclusion
 server-side breach trips the sticky global abort.
 
-Single-chip by design: buffered mode is a robustness/async study, not a
-throughput path; on a mesh use the sync round (this module raises).
+Single-chip by design: on a mesh use the sync round (this module
+raises). The host event loop itself is NOT training-only, though: it is
+externally steppable (``pump_events`` delivers due arrivals without
+dispatching a cohort), which is how the train-while-serve driver
+(commefficient_tpu/online/loop.py) interleaves buffered cohorts with
+the continuous-batching server's decode steps on one host loop — two
+program families sharing a process, never a jit program.
 """
 
 from __future__ import annotations
@@ -479,8 +484,9 @@ class BufferedFedLearner(FedLearner):
                  dispatch_interval: Optional[float] = None):
         if mesh is not None:
             raise ValueError(
-                "server_mode='buffered' is single-chip (robustness study, "
-                "not a throughput path); drop the mesh or use sync mode")
+                "server_mode='buffered' runs its event loop single-chip "
+                "(shared with the online serving loop, not a sharded "
+                "throughput path); drop the mesh or use sync mode")
         if cfg.server_mode != "buffered":
             raise ValueError("BufferedFedLearner needs cfg.server_mode="
                              f"'buffered', got {cfg.server_mode!r}")
@@ -645,12 +651,35 @@ class BufferedFedLearner(FedLearner):
         raw["lr"] = lr
         return raw
 
+    def pump_events(self, upto: Optional[float] = None):
+        """Externally-driven event-loop stepping: deliver every arrival
+        due by ``upto`` (default: the current dispatch clock,
+        ``cohorts_done * dispatch_interval``) WITHOUT dispatching a
+        cohort. This is the hook the train-while-serve driver
+        (online/loop.py) calls between server decode steps, so buffered
+        applies land at their scheduled sim times even while the host
+        loop is busy serving. Byte totals from pumped applies accumulate
+        directly (like flush_faults, they bypass
+        finalize_round_metrics). Returns the merged apply metrics
+        (host-side), or None when nothing was due."""
+        if upto is None:
+            upto = self.cohorts_done * self.dispatch_interval
+        am = self._drain(float(upto))
+        if am is None:
+            return None
+        out = jax.device_get(am)
+        self.total_download_bytes += float(out["download_bytes"])
+        self.total_upload_bytes += float(out["upload_bytes"])
+        return out
+
     def event_cursor(self) -> dict:
-        """Host event-loop position for checkpointing. In-flight heap
-        entries and any partial buffer are deliberately transient (see
-        utils/checkpoint.py: contributions are never saved) — the cursor
-        is the dispatch clock the fault model's pure-function schedule
-        replays from."""
+        """Host event-loop position for checkpointing — the cursor the
+        online serving loop rides into its mid-run checkpoints
+        (training/preempt.py) as well as the training CLI's. In-flight
+        heap entries and any partial buffer are deliberately transient
+        (see utils/checkpoint.py: contributions are never saved) — the
+        cursor is the dispatch clock the fault model's pure-function
+        schedule replays from."""
         return {"cohorts_done": self.cohorts_done,
                 "applies_done": self.applies_done,
                 "sim_time": float(self.sim_time),

@@ -1,0 +1,25 @@
+"""Train-while-serve: online personalization against a live server.
+
+The subsystem closes serving -> data -> training -> serving in ONE
+process (docs/SERVING.md "Online personalization"):
+
+- collector.py  — served interactions -> per-client federated examples,
+  plus the live-state store view personalization reads through
+- swap.py      — fingerprint-gated drain/swap/resubmit of fresh base
+  weights into the running server
+- loop.py      — the interleaved host loop and the ``--serve_online``
+  entrypoint driver
+"""
+
+from commefficient_tpu.online.collector import (InteractionCollector,
+                                                LearnerClientStore)
+from commefficient_tpu.online.loop import (OnlineLoop, build_heldout_batches,
+                                           build_traffic, eval_heldout,
+                                           extract_interaction, run_online)
+from commefficient_tpu.online.swap import HotSwapCoordinator
+
+__all__ = [
+    "InteractionCollector", "LearnerClientStore", "HotSwapCoordinator",
+    "OnlineLoop", "run_online", "build_traffic", "build_heldout_batches",
+    "eval_heldout", "extract_interaction",
+]

@@ -1,0 +1,546 @@
+"""Train-while-serve: one host loop interleaving serving and training.
+
+``OnlineLoop`` closes the loop the rest of the package builds parts for:
+
+    server.step() ──finished replies──> InteractionCollector
+         ^                                    │ every train_every
+         │                                    v interactions
+    swap_base_params <──applies──  BufferedFedLearner cohorts
+    (HotSwapCoordinator,            (pump_events delivers arrivals
+     every swap_every applies)       between decode steps)
+
+Everything is HOST interleaving: the server's jitted decode programs and
+the learner's jitted cohort/deposit/apply programs share a process and
+an accelerator, never a jit trace — each ``step()`` dispatches one
+decode round, then any due training work. Two cadences steer it
+(config.py): ``online_train_every`` (cohort per N served interactions)
+and ``online_swap_every`` (swap attempt per N buffered applies).
+
+Personalization needs no swap at all: cohorts rewrite the sparse client
+rows in ``learner.state.clients`` and the server's PersonalizationIndex
+reads those same rows (through LearnerClientStore) at the next
+admission. The swap is for the BASE weights only, and rides
+HotSwapCoordinator's drain -> gate -> swap -> resubmit sequence; the
+loop re-registers its per-request metadata for drained leftovers and
+resubmits them itself (new rids, same requests).
+
+Resume contract (training/preempt.py ``online=``): the loop's cursor —
+traffic position, cadence counters, swap count, and the collector's
+pending pools — rides into every checkpoint next to the learner's event
+cursor. A hard kill loses in-flight requests (the same transient-state
+contract as the buffered arrival heap); collected-but-untrained
+interactions SURVIVE, so a resume continues training without re-serving
+the traffic that produced them.
+
+``run_online`` is the gpt2 entrypoint's ``--serve_online`` driver: it
+replays persona-corpus traffic (per-user, gold-labeled) through the
+server, evaluates held-out per-user perplexity at every swap boundary,
+and checkpoints at swap boundaries so the whole online run is
+preemption-tolerant.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from commefficient_tpu.data.persona import IGNORE
+from commefficient_tpu.online.collector import (InteractionCollector,
+                                                LearnerClientStore)
+from commefficient_tpu.online.swap import HotSwapCoordinator
+
+
+class OnlineLoop:
+    """The interleaved serve/collect/train/swap driver for one server.
+
+    ``train_every`` / ``swap_every`` are the config cadences. The loop
+    owns per-request metadata (user, prompt, gold labels) keyed by rid —
+    ``submit`` registers it, finished replies consume it into the
+    collector, and a swap's drained leftovers are re-registered under
+    their fresh rids.
+    """
+
+    def __init__(self, server, collector: InteractionCollector, learner,
+                 coordinator: HotSwapCoordinator, *, train_every: int = 4,
+                 swap_every: int = 2, num_workers: int = 2,
+                 local_batch_size: int = 2, max_new: int = 16,
+                 log: bool = False):
+        if not hasattr(learner, "pump_events"):
+            raise ValueError(
+                "OnlineLoop drives the buffered event loop between decode "
+                "steps (pump_events); use BufferedFedLearner "
+                "(--server_mode buffered)")
+        if coordinator.resubmit:
+            raise ValueError(
+                "OnlineLoop resubmits drained leftovers itself (it must "
+                "re-register per-request metadata under the fresh rids); "
+                "build the HotSwapCoordinator with resubmit=False")
+        self.server = server
+        self.collector = collector
+        self.learner = learner
+        self.coordinator = coordinator
+        self.train_every = int(train_every)
+        self.swap_every = int(swap_every)
+        self.num_workers = int(num_workers)
+        self.local_batch_size = max(1, int(local_batch_size))
+        self.max_new = int(max_new)
+        self.log = bool(log)
+        #: rid -> (user_id, ids, types, reply_type, max_new, label_ids)
+        self._inflight: Dict[int, tuple] = {}
+        self.replies: Dict[int, List[int]] = {}
+        self.steps = 0
+        self.interactions = 0
+        self._interactions_trained = 0
+        self.rounds_done = 0
+        self.traffic_pos = 0
+        self.swaps = 0
+        self._applies_at_last_swap = int(learner.applies_done)
+        self.losses: List[float] = []
+
+    # ---- request lifecycle -------------------------------------------
+
+    def submit(self, ids, types, reply_type: int, max_new: int = None,
+               user_id=None, label_ids=None) -> int:
+        """server.submit + metadata registration (what turns the reply
+        into a training example when it finishes)."""
+        mx = int(max_new if max_new is not None else self.max_new)
+        rid = self.server.submit(ids, types, reply_type, mx,
+                                 user_id=user_id)
+        self._inflight[rid] = (user_id, list(ids), list(types),
+                               int(reply_type), mx, label_ids)
+        return rid
+
+    def inflight(self) -> int:
+        return len(self._inflight)
+
+    def _record_finished(self, finished) -> None:
+        for rid, toks in finished:
+            meta = self._inflight.pop(rid, None)
+            self.replies[rid] = list(toks)
+            if meta is None:
+                continue
+            user_id, ids, types, reply_type, _mx, label_ids = meta
+            if user_id is None:
+                continue                 # anonymous traffic trains nobody
+            self.collector.record(user_id, ids, types, toks, reply_type,
+                                  label_ids=label_ids)
+            self.interactions += 1
+
+    # ---- the interleaved step ----------------------------------------
+
+    def step(self):
+        """One host-loop turn: a decode round, then due training work.
+        Returns the requests finished this turn (including any drained
+        by a swap) as (rid, reply_tokens)."""
+        finished = self.server.step()
+        self._record_finished(finished)
+        out = list(finished)
+        while (self.collector.has_work()
+               and (self.interactions - self._interactions_trained)
+               >= self.train_every):
+            self._train_one_cohort()
+        # deliver buffered arrivals due at the current dispatch clock —
+        # applies land at their sim times even while the loop serves
+        self.learner.pump_events()
+        if (int(self.learner.applies_done) - self._applies_at_last_swap
+                >= self.swap_every):
+            out.extend(self.try_swap())
+        self.steps += 1
+        return out
+
+    def _train_one_cohort(self) -> Optional[dict]:
+        ids, cols, mask = self.collector.sample_round(
+            self.num_workers, self.local_batch_size)
+        if not mask.any():
+            self._interactions_trained = self.interactions
+            return None
+        raw = self.learner.train_round_async(ids, cols, mask,
+                                             epoch_frac=self.rounds_done)
+        out = self.learner.finalize_round_metrics(raw)
+        self.rounds_done += 1
+        self._interactions_trained += self.train_every
+        self.losses.append(float(out["loss"]))
+        if self.log:
+            print(f"online cohort {self.rounds_done}: "
+                  f"loss={out['loss']:.4f} "
+                  f"applies={int(self.learner.applies_done)}", flush=True)
+        return out
+
+    def try_swap(self):
+        """Drain -> gate -> swap via the coordinator, then re-register
+        and resubmit the drained leftovers: after the drain, the
+        still-inflight rids (ascending) correspond 1:1 to the sorted
+        leftovers the server handed back, so metadata carries over to
+        the fresh rids. Returns the drained replies."""
+        replies, leftovers = self.coordinator.swap(self.learner.params)
+        self._record_finished(sorted(replies.items()))
+        waiting = sorted(self._inflight)
+        assert len(waiting) == len(leftovers), \
+            f"{len(waiting)} tracked vs {len(leftovers)} drained leftovers"
+        metas = [self._inflight.pop(r) for r in waiting]
+        for user_id, ids, types, reply_type, mx, label_ids in metas:
+            self.submit(ids, types, reply_type, max_new=mx,
+                        user_id=user_id, label_ids=label_ids)
+        self._applies_at_last_swap = int(self.learner.applies_done)
+        self.swaps += 1
+        if self.log:
+            st = self.server.stats()
+            drift = st.get("acceptance_rate_since_swap")
+            print(f"swap {self.swaps}: {len(replies)} drained, "
+                  f"{len(leftovers)} resubmitted, drift_accept="
+                  f"{'n/a' if drift is None else f'{drift:.3f}'}",
+                  flush=True)
+        return sorted(replies.items())
+
+    # ---- preemption cursor (training/preempt.py ``online=``) ---------
+
+    def cursor(self) -> dict:
+        return {"steps": self.steps, "interactions": self.interactions,
+                "interactions_trained": self._interactions_trained,
+                "rounds_done": self.rounds_done,
+                "traffic_pos": self.traffic_pos,
+                "applies_at_last_swap": self._applies_at_last_swap,
+                "swaps": self.swaps,
+                "server_swaps": int(self.server.swaps_done),
+                "collector": self.collector.cursor()}
+
+    def restore_cursor(self, cur: dict) -> None:
+        self.steps = int(cur["steps"])
+        self.interactions = int(cur["interactions"])
+        self._interactions_trained = int(cur["interactions_trained"])
+        self.rounds_done = int(cur["rounds_done"])
+        self.traffic_pos = int(cur["traffic_pos"])
+        self._applies_at_last_swap = int(cur["applies_at_last_swap"])
+        self.swaps = int(cur["swaps"])
+        self.server.swaps_done = int(cur["server_swaps"])
+        self.collector.restore_cursor(cur["collector"])
+        # in-flight requests at the kill are lost by contract (the same
+        # transient-state rule as the buffered arrival heap); the
+        # collector's pending pools above are what survives
+        self._inflight = {}
+
+
+# ----------------------------------------------------------------------
+# Traffic from the persona corpus (the results/audit/e2e driver)
+# ----------------------------------------------------------------------
+
+def extract_interaction(train_set, flat_idx: int):
+    """One cached train example -> a servable (prompt, gold) interaction.
+
+    The cache row's LAST candidate is the gold one: its first labeled
+    position p0 marks where the reply starts, so ``ids[:p0]`` (context +
+    reply-speaker token) is the serving prompt and ``ids[p0:mc+1]`` (the
+    reply plus eos) is the gold continuation the collector trains
+    against. Returns None for degenerate rows (no labeled positions)."""
+    cols = train_set.get_flat_batch(np.asarray([int(flat_idx)]))
+    ids = np.asarray(cols[0][0][-1])
+    mc = int(np.asarray(cols[1][0][-1]))
+    labels = np.asarray(cols[2][0][-1])
+    types = np.asarray(cols[4][0][-1])
+    lab_pos = np.nonzero(labels != IGNORE)[0]
+    if lab_pos.size == 0:
+        return None
+    p0 = int(lab_pos[0])
+    if p0 == 0 or mc < p0:
+        return None
+    return {"prompt": ids[:p0].tolist(), "types": types[:p0].tolist(),
+            "gold": ids[p0:mc + 1].tolist(),
+            "reply_type": int(types[p0])}
+
+
+def build_traffic(train_set, max_per_user: int = None):
+    """Deterministic replayable traffic + a held-out split.
+
+    Each overlay client's flat range is split alternately: EVEN
+    positions become servable traffic, ODD positions the held-out
+    per-user evaluation set (never served, never trained — what the
+    perplexity trajectory is honest against). Traffic interleaves users
+    round-robin so every user's personalization row sees regular
+    updates. Returns ``(traffic, heldout)``: a list of interaction
+    dicts (with ``user``) and ``{user: [flat_idx, ...]}``."""
+    per_user_items: Dict[int, list] = {}
+    heldout: Dict[int, List[int]] = {}
+    for u, (start, end) in enumerate(train_set.client_slices()):
+        idxs = list(range(start, end))
+        serve_idxs = idxs[0::2] or idxs[:1]
+        hold_idxs = idxs[1::2] or idxs[:1]
+        if max_per_user:
+            serve_idxs = serve_idxs[:max_per_user]
+            hold_idxs = hold_idxs[:max_per_user]
+        items = []
+        for fi in serve_idxs:
+            it = extract_interaction(train_set, fi)
+            if it is not None:
+                it["user"] = u
+                items.append(it)
+        if items:
+            per_user_items[u] = items
+            heldout[u] = hold_idxs
+    traffic = []
+    depth = max((len(v) for v in per_user_items.values()), default=0)
+    for i in range(depth):
+        for u in sorted(per_user_items):
+            items = per_user_items[u]
+            traffic.append(items[i % len(items)])
+    return traffic, heldout
+
+
+def build_heldout_batches(train_set, heldout: Dict[int, List[int]],
+                          batch_cap: int = 8):
+    """Fixed-shape per-user eval batches (ONE eval compile): every
+    user's held-out rows padded to a common batch size."""
+    E = min(batch_cap, max((len(v) for v in heldout.values()), default=1))
+    out = []
+    for u in sorted(heldout):
+        idxs = np.asarray(heldout[u][:E])
+        data = train_set.get_flat_batch(idxs)
+        b = len(idxs)
+        mask = np.zeros(E, np.float32)
+        mask[:b] = 1.0
+        cols = []
+        for d in data:
+            pad = np.zeros((E,) + d.shape[1:], d.dtype)
+            pad[:b] = d
+            cols.append(pad)
+        out.append((u, tuple(cols), mask))
+    return out
+
+
+def eval_heldout(learner, store, heldout_batches, scale: float = 1.0):
+    """Held-out per-user nll under base + that user's CURRENT delta.
+
+    Each user's sparse errors row is densified one at a time — an O(d)
+    scratch vector per user, never an ``(num_clients, d)`` table — added
+    onto the flat server weights, and evaluated over that user's
+    held-out batch. The learner's rng is snapshotted around the whole
+    sweep so evaluation never perturbs the training trajectory
+    (gpt2.py's eval_before_start convention)."""
+    rng_before = learner.rng
+    base_state = learner.state
+    per_user: Dict[int, float] = {}
+    try:
+        for u, cols, mask in heldout_batches:
+            row = store.row("errors", u)
+            idx = np.asarray(row["idx"], np.int64)
+            val = np.asarray(row["val"], np.float32)
+            live = val != 0.0
+            dense = np.zeros(int(base_state.weights.shape[0]), np.float32)
+            np.add.at(dense, np.minimum(idx[live], dense.shape[0] - 1),
+                      np.float32(scale) * val[live])
+            learner.state = base_state.replace(
+                weights=base_state.weights + jnp.asarray(dense))
+            out = learner.evaluate([(cols, mask)])
+            m = np.asarray(out["metrics"])
+            if m.size >= 3 and float(m[2]) > 0:
+                nll = float(m[1]) / float(m[2])
+            else:
+                nll = float(out["loss"])
+            per_user[u] = nll
+    finally:
+        learner.state = base_state
+        learner.rng = rng_before
+    mean = (float(np.mean(list(per_user.values()))) if per_user
+            else float("nan"))
+    return {"per_user": per_user, "mean_nll": mean,
+            "mean_ppl": float(np.exp(min(mean, 20.0)))
+            if per_user else float("nan")}
+
+
+# ----------------------------------------------------------------------
+# The --serve_online entrypoint driver
+# ----------------------------------------------------------------------
+
+def run_online(args, mesh=None, log: bool = True,
+               target_swaps: int = 2, max_steps: int = 5000,
+               eval_every_swap: bool = True):
+    """Serve persona traffic, train on it, hot-swap, measure.
+
+    Builds the whole stack — tokenizer/dataset, tiny-GPT2 buffered
+    learner, DecodeEngine + paged personalized server over the
+    learner's LIVE client state, HotSwapCoordinator gated on this run's
+    config fingerprint — then drives ``OnlineLoop`` until
+    ``target_swaps`` hot swaps have landed, evaluating held-out
+    per-user perplexity at every swap boundary and checkpointing there
+    when ``--checkpoint_every_rounds`` is active. Single-chip by
+    construction (the buffered event loop's contract).
+    """
+    if mesh is not None:
+        raise ValueError(
+            "--serve_online interleaves the buffered event loop with the "
+            "decode server on ONE host/chip; drop the mesh")
+    from commefficient_tpu.data.tokenizer import get_tokenizer
+    from commefficient_tpu.federated.api import set_transfer_guard
+    from commefficient_tpu.federated.losses import (make_gpt2_train_loss,
+                                                    make_gpt2_val_loss)
+    from commefficient_tpu.models.gpt2 import GPT2Config, GPT2DoubleHeads
+    from commefficient_tpu.serving.decode import DecodeEngine
+    from commefficient_tpu.serving.personalize import PersonalizationIndex
+    from commefficient_tpu.serving.server import ContinuousBatchingServer
+    from commefficient_tpu.training.args import (args_to_config,
+                                                 learner_factory)
+    from commefficient_tpu.training.gpt2 import make_persona
+    from commefficient_tpu.training.preempt import (PreemptionGuard,
+                                                    TrainCheckpointer,
+                                                    config_fingerprint)
+
+    set_transfer_guard(getattr(args, "transfer_guard", "disallow"))
+    tokenizer = get_tokenizer(args.model_checkpoint)
+    train_set = make_persona(args, tokenizer, train=True)
+    args.num_clients = train_set.num_clients
+    num_clients = train_set.num_clients
+    eos = tokenizer.convert_tokens_to_ids("<eos>")
+
+    if args.model == "gpt2":
+        gcfg = GPT2Config.small(vocab_size=tokenizer.vocab_size)
+    else:
+        gcfg = GPT2Config.tiny(vocab_size=tokenizer.vocab_size)
+    gcfg.n_positions = max(gcfg.n_positions, args.max_seq_len)
+    model = GPT2DoubleHeads(gcfg)
+    loss_tr = make_gpt2_train_loss(model, args.lm_coef, args.mc_coef)
+    loss_val = make_gpt2_val_loss(model)
+
+    cfg = args_to_config(args, num_clients=num_clients,
+                         max_seq_len=args.max_seq_len)
+    if not cfg.serve_online:
+        raise ValueError("run_online needs --serve_online (with "
+                         "--server_mode buffered --serve_personalized "
+                         "--client_state sparse)")
+
+    # online interactions carry no distractor candidates: the collector
+    # (and the cohort program's compiled shapes) use C=1
+    collector = InteractionCollector(num_clients, args.max_seq_len,
+                                     num_candidates=1, eos_id=eos)
+    sample = collector.sample_batch()
+    sample_in = (sample[0], sample[4], sample[1])
+
+    class _Wrap:
+        def init(self, rng, s, train):
+            return model.init(rng, *s, train=train)
+
+        def apply(self, *a, **k):
+            return model.apply(*a, **k)
+
+    learner_cls, learner_extra = learner_factory(args, cfg.num_clients)
+    learner = learner_cls(_Wrap(), cfg, loss_tr, loss_val,
+                          jax.random.PRNGKey(args.seed), sample_in,
+                          lr_schedule=None, mesh=None, **learner_extra)
+    store = LearnerClientStore(learner)
+    collector.store = store
+
+    engine = DecodeEngine(model, learner.params, eos_id=eos,
+                          max_len=args.max_seq_len,
+                          method=getattr(args, "serve_sample", "greedy"))
+    personalize = PersonalizationIndex(engine.params, store)
+    server = ContinuousBatchingServer(
+        engine, slots=getattr(args, "serve_slots", 8),
+        prefill_len=args.max_seq_len, kv_cache="paged",
+        personalize=personalize,
+        speculate_k=getattr(args, "speculate_k", 0))
+
+    fp = config_fingerprint(args, "gpt2_online")
+    coordinator = HotSwapCoordinator(server, learner,
+                                     expect_fingerprint=fp,
+                                     source_fingerprint=fp,
+                                     resubmit=False, log=log)
+    loop = OnlineLoop(server, collector, learner, coordinator,
+                      train_every=args.online_train_every,
+                      swap_every=args.online_swap_every,
+                      num_workers=args.num_workers,
+                      local_batch_size=args.local_batch_size,
+                      max_new=min(24, args.max_seq_len // 4), log=log)
+
+    ckpt = TrainCheckpointer(args, learner, None, entry="gpt2_online",
+                             online=loop, log=log)
+    ckpt.resume()
+
+    traffic, heldout = build_traffic(train_set)
+    if not traffic:
+        raise ValueError("persona corpus produced no servable traffic")
+    heldout_batches = build_heldout_batches(train_set, heldout)
+
+    scale = personalize.scale
+
+    def eval_point():
+        # base+delta (what a personalized user experiences) AND base-only
+        # (the shared weights alone) at every swap boundary: the gap
+        # between the two trajectories is what the per-user deltas buy —
+        # results.py --online reports the decomposition
+        pt = dict(eval_heldout(learner, store, heldout_batches,
+                               scale=scale), swaps=loop.swaps)
+        base = eval_heldout(learner, store, heldout_batches, scale=0.0)
+        pt["mean_nll_base"] = base["mean_nll"]
+        pt["mean_ppl_base"] = base["mean_ppl"]
+        return pt
+
+    trajectory = [eval_point()]
+    if log:
+        print(f"online: {len(traffic)} traffic items over "
+              f"{len(heldout_batches)} users; baseline heldout "
+              f"ppl={trajectory[0]['mean_ppl']:.2f}", flush=True)
+
+    guard = PreemptionGuard(enabled=ckpt.active, log=log)
+    preempted = False
+    with guard:
+        while loop.swaps < target_swaps and loop.steps < max_steps:
+            while loop.inflight() < server.slots:
+                item = traffic[loop.traffic_pos % len(traffic)]
+                loop.submit(item["prompt"], item["types"],
+                            item["reply_type"],
+                            max_new=max(1, len(item["gold"])),
+                            user_id=item["user"], label_ids=item["gold"])
+                loop.traffic_pos += 1
+            before = loop.swaps
+            loop.step()
+            if loop.swaps > before:
+                if eval_every_swap:
+                    trajectory.append(eval_point())
+                if ckpt.active:
+                    ckpt.save(epoch=loop.swaps, rounds_in_epoch=0,
+                              total_rounds=loop.rounds_done,
+                              in_epoch=False)
+            if guard.triggered:
+                preempted = True
+                if ckpt.active:
+                    ckpt.save(epoch=loop.swaps, rounds_in_epoch=0,
+                              total_rounds=loop.rounds_done,
+                              in_epoch=False)
+                break
+
+    learner.flush_faults()
+    final = eval_point()
+    if final["mean_nll"] != trajectory[-1]["mean_nll"]:
+        trajectory.append(final)
+    first, last = trajectory[0]["mean_nll"], trajectory[-1]["mean_nll"]
+    results = {
+        "swaps": loop.swaps,
+        "dirty_swaps": int(server.dirty_swaps),
+        "refused_swaps": int(coordinator.refused),
+        "steps": loop.steps,
+        "interactions": loop.interactions,
+        "rounds": loop.rounds_done,
+        "applies": int(learner.applies_done),
+        "collected": collector.collected,
+        "train_losses": loop.losses,
+        "heldout_trajectory": [
+            {"swaps": t["swaps"], "mean_nll": t["mean_nll"],
+             "mean_ppl": t["mean_ppl"],
+             "mean_nll_base": t.get("mean_nll_base"),
+             "mean_ppl_base": t.get("mean_ppl_base")}
+            for t in trajectory],
+        "heldout_nll_first": first,
+        "heldout_nll_last": last,
+        "heldout_improved": bool(last < first),
+        "preempted": preempted,
+        "server_stats": {k: v for k, v in server.stats().items()
+                         if not isinstance(v, (list, dict))},
+    }
+    if log:
+        verdict = "improved" if results["heldout_improved"] else "NOT improved"
+        print(f"online done: swaps={loop.swaps} "
+              f"interactions={loop.interactions} rounds="
+              f"{loop.rounds_done} heldout nll {first:.4f} -> {last:.4f} "
+              f"({verdict})", flush=True)
+    return learner, loop, results

@@ -1,0 +1,118 @@
+"""Hot-swap coordination: refreshed weights into a live server, safely.
+
+``HotSwapCoordinator`` owns the one safe sequence for promoting newly
+trained base weights into a running ContinuousBatchingServer:
+
+    fingerprint gate -> drain -> swap_base_params -> resubmit leftovers
+
+in that order, each step for a reason:
+
+* the FINGERPRINT GATE runs first, before anything is disturbed: a
+  refusal (weights trained under a different config than the server is
+  serving) leaves the server fully serving — queues intact, slots
+  decoding, nothing drained. The comparison is the same set-union key
+  diff utils/checkpoint.load_checkpoint applies on resume, so the
+  online path and the checkpoint path refuse the same mismatches with
+  the same wording style.
+* DRAIN finishes every admitted request under its admission-time
+  weights (greedy replies stay token-identical to a solo generate) and
+  evicts every per-user delta through the bitwise base-restore path —
+  only then is the server's params object safe to move.
+* SWAP places the new leaves onto the old leaves' shardings/dtypes and
+  rebases the personalization index; every jitted serving program takes
+  params per call, so no compile cache grows.
+* RESUBMIT re-queues the drained leftovers verbatim (same ids, types,
+  budget, user routing) — queued-but-never-admitted work survives the
+  swap with nothing lost but queue position.
+
+``force=True`` (the online_loop audit target's mutation arm) skips the
+drain and swaps under active slots: the deliberate contract violation
+the audit must catch as ``dirty_swaps > 0`` and broken greedy parity.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class HotSwapCoordinator:
+    """Drain -> gate -> swap -> resubmit for one server (+ counters).
+
+    ``learner`` (optional) is the weight source when ``swap`` is called
+    without explicit params. ``expect_fingerprint`` is what the SERVER
+    is serving (the run's config_fingerprint); ``source_fingerprint`` is
+    attached to incoming weights by default — in-process training passes
+    the same dict for both (trivially matching), while weights restored
+    from a checkpoint carry that checkpoint's fingerprint and can
+    mismatch. ``resubmit=False`` hands the leftovers back to the caller
+    instead (online/loop.py re-registers its per-request metadata and
+    resubmits them itself).
+    """
+
+    def __init__(self, server, learner=None, *,
+                 expect_fingerprint: Optional[dict] = None,
+                 source_fingerprint: Optional[dict] = None,
+                 resubmit: bool = True, log: bool = False):
+        self.server = server
+        self.learner = learner
+        self.expect_fingerprint = expect_fingerprint
+        self.source_fingerprint = source_fingerprint
+        self.resubmit = bool(resubmit)
+        self.log = bool(log)
+        self.swaps_done = 0
+        self.refused = 0
+
+    def check_fingerprint(self, fingerprint: Optional[dict]) -> None:
+        """Refuse weights whose config fingerprint disagrees with the
+        serving run's (same set-union comparison as checkpoint resume,
+        utils/checkpoint.py). ``None`` on either side skips the gate —
+        an ungated in-process swap, the caller's explicit choice."""
+        if self.expect_fingerprint is None or fingerprint is None:
+            return
+        bad = sorted(
+            k for k in set(fingerprint) | set(self.expect_fingerprint)
+            if fingerprint.get(k) != self.expect_fingerprint.get(k))
+        if bad:
+            self.refused += 1
+            detail = ", ".join(
+                f"{k}: incoming={fingerprint.get(k)!r} "
+                f"serving={self.expect_fingerprint.get(k)!r}" for k in bad)
+            raise ValueError(
+                f"hot swap refused: incoming weights were trained under "
+                f"a different config than this server serves — the "
+                f"server keeps serving its current weights untouched. "
+                f"Mismatched: {detail}")
+
+    def swap(self, new_params=None, *, fingerprint=None,
+             force: bool = False):
+        """Run the full sequence; returns ``(replies, leftovers)`` —
+        the drained in-flight replies (rid -> tokens) and the
+        never-admitted queue entries (already re-submitted under fresh
+        rids when ``self.resubmit``; submission order preserved).
+
+        The gate runs BEFORE the drain: a ValueError here means the
+        server was never touched. ``force=True`` skips the drain and
+        swaps under whatever is active (audit mutation arm only)."""
+        fp = fingerprint if fingerprint is not None \
+            else self.source_fingerprint
+        self.check_fingerprint(fp)
+        if new_params is None:
+            if self.learner is None:
+                raise ValueError("swap needs new_params or a learner "
+                                 "to pull them from")
+            new_params = self.learner.params
+        if force:
+            replies, leftovers = {}, []
+        else:
+            replies, leftovers = self.server.drain()
+        self.server.swap_base_params(new_params, force=force)
+        if self.resubmit and not force:
+            for left in leftovers:
+                self.server.submit(*left)
+        self.swaps_done += 1
+        if self.log:
+            print(f"hot swap {self.swaps_done}: {len(replies)} drained, "
+                  f"{len(leftovers)} resubmitted"
+                  + (" [FORCED under active slots]" if force else ""),
+                  flush=True)
+        return replies, leftovers

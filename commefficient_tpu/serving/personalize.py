@@ -137,6 +137,41 @@ class PersonalizationIndex:
 
     # ---- server hooks -------------------------------------------------
 
+    def rebase(self, new_base_params, *, force: bool = False) -> None:
+        """Re-anchor the index on refreshed BASE weights (the
+        train-while-serve hot swap, online/swap.py).
+
+        Must run with NO active users: the server drains first, every
+        delta evicts through the bitwise base-restore path above, and
+        only then do ``base``/``_base_leaves`` move — so post-swap
+        admissions scatter over (and evictions restore) the NEW base.
+        Leaf offsets/sizes are shape-derived and a swap never changes
+        shapes, so the flat index space — and the store rows indexing
+        it — carry over unchanged.
+
+        ``force=True`` (the audit mutation arm only) rebases under
+        active users; their recorded deltas now disagree with what is
+        on device, which is exactly the breakage the ``online_loop``
+        target must detect.
+        """
+        if self.active and not force:
+            raise RuntimeError(
+                f"rebase with {len(self.active)} active user(s) — evict "
+                f"them first (server.drain()) so the bitwise "
+                f"base-restore contract survives the swap")
+        leaves, treedef = jax.tree_util.tree_flatten(new_base_params)
+        if treedef != self._treedef:
+            raise ValueError(
+                "rebase: new base params tree does not match the "
+                "serving tree — wrong model/config")
+        for i, (o, n) in enumerate(zip(self._base_leaves, leaves)):
+            if tuple(np.shape(o)) != tuple(np.shape(n)):
+                raise ValueError(
+                    f"rebase: leaf {i} has shape {np.shape(n)}, index "
+                    f"expects {np.shape(o)} — wrong model/config")
+        self.base = new_base_params
+        self._base_leaves = leaves
+
     def admit(self, params, user_id: int):
         """Apply ``user_id``'s delta to ``params`` (refcounted: a user
         already active in another slot is applied once and counted)."""

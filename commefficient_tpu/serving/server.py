@@ -49,6 +49,20 @@ greedy stream. Rejected paged entries roll back host-side
 (``PagedKVCache.truncate``). Still exactly one draft + one verify
 program for the server's lifetime, and still ONE host pull per step.
 
+Train-while-serve (commefficient_tpu/online/): the buffered federated
+event loop and this server interleave on ONE host loop — the
+interaction collector turns finished replies into per-client examples,
+``BufferedFedLearner`` cohorts write the same sparse client rows the
+personalization index reads as per-user deltas, and
+``swap_base_params`` promotes refreshed base weights into the live
+server. The safe sequence (drain → fingerprint gate → swap → resubmit
+leftovers) lives in online/swap.py; every jitted program takes params
+per call, so a swap re-uses every compile (cache stays at 1). The
+speculative drafter deliberately keeps its pre-swap snapshot, so its
+acceptance rate against the advancing target doubles as a live
+personalization-drift metric
+(``stats()['acceptance_rate_since_swap']``).
+
 Multi-host serving (docs/SERVING.md "Multi-host") composes three
 orthogonal pieces on top:
 
@@ -211,6 +225,8 @@ class ContinuousBatchingServer:
         self._spilled_per_shard = np.zeros((self.num_shards,), np.int64)
         self._slot_req: List[_Request] = [None] * B
         self._next_rid = 0
+        self.swaps_done = 0
+        self.dirty_swaps = 0
         self._insert = jax.jit(self._insert_raw)
         self._set_row = jax.jit(self._set_row_raw)
         self._release = jax.jit(self._release_raw)
@@ -221,7 +237,10 @@ class ContinuousBatchingServer:
 
             # constructed BEFORE any personalized admission, so the
             # default (self-drafting) drafter snapshots pristine base
-            # params — the free personalized drafter
+            # params — the free personalized drafter. The snapshot is
+            # also deliberately NOT refreshed by swap_base_params: as
+            # online training advances the target, the stale drafter's
+            # acceptance rate becomes the live drift metric.
             self.spec = SpeculativeDecoder(
                 engine, gamma=speculate_k, slots=B,
                 drafter_model=drafter_model, drafter_params=drafter_params)
@@ -233,6 +252,7 @@ class ContinuousBatchingServer:
             self._accepted = np.zeros((B,), np.int64)
             self._spec_totals = {"drafted": 0, "accepted": 0,
                                  "corrected": 0, "rounds": 0}
+            self._spec_swap_mark = dict(self._spec_totals)
 
     # ---- jitted slot surgery (slot index is TRACED: no per-slot
     # recompiles, which the decode audit target's retrace guard relies
@@ -533,6 +553,79 @@ class ContinuousBatchingServer:
                 self.pager.truncate(slot, int(ph[slot]))
         return finished
 
+    def swap_base_params(self, new_params, *, force: bool = False):
+        """Promote refreshed BASE weights into the live server (the
+        train-while-serve hot swap, online/swap.py).
+
+        Contract (docs/SERVING.md "Online personalization"): call with
+        NO active slots — ``drain()`` first — so every per-user delta
+        has already been evicted through the bitwise base-restore path
+        and every in-flight greedy reply finished under the weights it
+        was admitted with. Every jitted program (prefill, step,
+        paged_step, draft, verify) takes params per call, and the new
+        leaves are placed onto each old leaf's sharding and dtype, so
+        the swap re-uses every compile: caches stay at 1 through it.
+        The attached personalization index is rebased to the new
+        weights so post-swap admissions scatter deltas over (and
+        evictions restore) the NEW base.
+
+        ``force=True`` swaps under active slots anyway (counted in
+        ``dirty_swaps``): in-flight requests continue under the NEW
+        weights and any resident per-user delta is dropped, so greedy
+        parity across the boundary is knowingly broken — only the
+        ``online_loop`` audit target's mutation arm should do this.
+        """
+        old = self.personalize.base if self.personalize is not None \
+            else self.engine.params
+        old_leaves, old_def = jax.tree_util.tree_flatten(old)
+        new_leaves, new_def = jax.tree_util.tree_flatten(new_params)
+        if new_def != old_def:
+            raise ValueError(
+                "swap_base_params: incoming params tree does not match "
+                "the serving tree — wrong model/config")
+        for i, (o, n) in enumerate(zip(old_leaves, new_leaves)):
+            if tuple(np.shape(o)) != tuple(np.shape(n)):
+                raise ValueError(
+                    f"swap_base_params: leaf {i} has shape {np.shape(n)},"
+                    f" serving expects {np.shape(o)} — wrong model/config")
+        active = [s for s, r in enumerate(self._slot_req)
+                  if r is not None]
+        if active and not force:
+            raise RuntimeError(
+                f"swap_base_params with {len(active)} active slot(s) — "
+                f"drain() first so per-user deltas evict (bitwise base "
+                f"restore) and in-flight replies finish under their "
+                f"admission-time weights, or pass force=True to break "
+                f"parity knowingly")
+        # placement preserves each old leaf's jit CALL SIGNATURE, not
+        # just its sharding: jit caches key on whether an argument is
+        # committed to its device, so an uncommitted serving leaf (the
+        # common single-chip case — model.init output) must be replaced
+        # by an uncommitted array (host-roundtripped jnp.asarray), while
+        # a committed leaf (TP-sharded serving) takes an explicit
+        # device_put onto the old sharding. Mixing them grows a second
+        # cache entry per program on the first swap.
+        def _place(o, n):
+            if isinstance(o, jax.Array) and getattr(o, "_committed",
+                                                    False):
+                return jax.device_put(jnp.asarray(n, dtype=o.dtype),
+                                      o.sharding)
+            return jnp.asarray(np.asarray(n), dtype=o.dtype)
+
+        placed = jax.tree_util.tree_unflatten(old_def, [
+            _place(o, n) for o, n in zip(old_leaves, new_leaves)])
+        self.engine.params = placed
+        if self.personalize is not None:
+            self.personalize.rebase(placed, force=force)
+        self.swaps_done += 1
+        if active:
+            self.dirty_swaps += 1
+        if self.spec is not None:
+            # reset the since-swap window; spec.dparams stays on its
+            # pre-swap snapshot (see the constructor comment)
+            self._spec_swap_mark = dict(self._spec_totals)
+        return placed
+
     def stats(self) -> Dict[str, object]:
         """Speculation counters: drafted/accepted/corrected totals, the
         aggregate acceptance rate (accepted drafts / drafted), and the
@@ -556,6 +649,15 @@ class ContinuousBatchingServer:
                 (float(self._accepted[i] / self._drafted[i])
                  if self._drafted[i] else None)
                 for i in range(self.slots)]
+            # windowed on the last swap_base_params: with the drafter
+            # pinned to its pre-swap snapshot, a falling value here IS
+            # the personalization-drift signal (how far online training
+            # has moved the target since the drafter last saw it)
+            dsw = s["drafted"] - self._spec_swap_mark["drafted"]
+            asw = s["accepted"] - self._spec_swap_mark["accepted"]
+            s["drafted_since_swap"] = dsw
+            s["accepted_since_swap"] = asw
+            s["acceptance_rate_since_swap"] = (asw / dsw) if dsw else None
         if self.pager is not None:
             from commefficient_tpu.ops import kv_quant as kvq
             cfg = self.engine.model.config
@@ -572,6 +674,8 @@ class ContinuousBatchingServer:
         # routing — admitted/spilled per slot pool, plus the store's own
         # shard read/write counters when a personalization index is
         # attached, so bench rows can report routing skew directly
+        s["swaps_done"] = self.swaps_done
+        s["dirty_swaps"] = self.dirty_swaps
         s["tp"] = self.engine.tp
         s["disaggregated"] = self.disaggregate
         if self.disaggregate:

@@ -204,6 +204,25 @@ def build_parser(default_lr: float = 0.4) -> argparse.ArgumentParser:
                         "write — a prefill burst cannot stall admitted "
                         "decode slots. Requires the paged KV cache and "
                         ">= 2 slots")
+    p.add_argument("--serve_online", action="store_true",
+                   help="train-while-serve (commefficient_tpu/online/): "
+                        "run the continuous-batching server and buffered "
+                        "federated cohorts on ONE host loop — served "
+                        "interactions become per-client training "
+                        "examples, cohorts write the same sparse client "
+                        "rows serving reads as per-user deltas, and "
+                        "refreshed base weights hot-swap into the live "
+                        "server (drain -> fingerprint gate -> swap -> "
+                        "resubmit leftovers; greedy replies stay "
+                        "token-identical across each swap for requests "
+                        "served on one side of it). Requires "
+                        "--server_mode buffered and --serve_personalized")
+    p.add_argument("--online_train_every", type=int, default=4,
+                   help="--serve_online: dispatch one buffered cohort "
+                        "every this many served interactions")
+    p.add_argument("--online_swap_every", type=int, default=2,
+                   help="--serve_online: attempt a base-weight hot swap "
+                        "every this many buffered applies")
     p.add_argument("--offload_pipeline_depth", type=int, default=2,
                    help="rounds of offloaded output rows that may queue "
                         "for lazy host writeback (api.HostOffloadPipeline)"

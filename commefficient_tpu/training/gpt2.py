@@ -579,6 +579,17 @@ def main(argv=None):
     round_up_workers_for_mesh(args, mesh)
     np.random.seed(args.seed)
     from commefficient_tpu.utils.logging import profile_ctx
+    if getattr(args, "serve_online", False):
+        # train-while-serve (online/loop.py): serve persona traffic,
+        # train on it through the buffered event loop, hot-swap the
+        # refreshed weights back into the running server
+        from commefficient_tpu.online import run_online
+        with profile_ctx(args.profile):
+            _, _, results = run_online(args, mesh=mesh)
+        print("final:", {k: (round(v, 4) if isinstance(v, float) else v)
+                         for k, v in results.items()
+                         if not isinstance(v, (list, dict))})
+        return 0
     with profile_ctx(args.profile):
         _, final = train(args, mesh=mesh)
     print("final:", {k: round(v, 4) if isinstance(v, float) else v

@@ -57,6 +57,9 @@ _FINGERPRINT_FIELDS = (
     "quarantine_rounds", "fault_seed", "fault_dropout_prob",
     "fault_crash_prob", "straggler_frac", "straggler_mult", "base_latency",
     "latency_sigma", "dispatch_interval",
+    # train-while-serve (online/loop.py): traffic order, cohort cadence
+    # and swap cadence all steer which examples each round sees
+    "serve_online", "online_train_every", "online_swap_every",
     # DP
     "do_dp", "dp_mode", "l2_norm_clip", "noise_multiplier",
     # gpt2-only (None for cv runs)
@@ -130,7 +133,7 @@ class TrainCheckpointer:
     """Periodic/preemption checkpointing + resume for one training run."""
 
     def __init__(self, args, learner, batcher, entry: str, meta: dict = None,
-                 log: bool = True):
+                 log: bool = True, online=None):
         self.every = int(getattr(args, "checkpoint_every_rounds", 0) or 0)
         self.resume_spec = getattr(args, "resume", None)
         self.path = args.checkpoint_path
@@ -140,6 +143,12 @@ class TrainCheckpointer:
         self.entry = entry
         self.meta = meta
         self.log = log
+        # train-while-serve (online/loop.py): an object with
+        # ``cursor()``/``restore_cursor(payload)`` — the traffic position,
+        # collected-but-untrained per-user shards, and swap count ride
+        # into the checkpoint so an online resume continues WITHOUT
+        # re-serving (and re-collecting) the traffic it already saw
+        self.online = online
         self.fingerprint = config_fingerprint(args, entry)
 
     @property
@@ -158,9 +167,14 @@ class TrainCheckpointer:
         cursor = {"entry": self.entry, "epoch": epoch,
                   "rounds_in_epoch": rounds_in_epoch,
                   "total_rounds": total_rounds, "in_epoch": in_epoch,
-                  "data": self.batcher.cursor(in_epoch)}
+                  # the online entrypoint has no epoch batcher — its data
+                  # order lives in the collector cursor below
+                  "data": (self.batcher.cursor(in_epoch)
+                           if self.batcher is not None else None)}
         if hasattr(self.learner, "event_cursor"):
             cursor["buffered"] = self.learner.event_cursor()
+        if self.online is not None:
+            cursor["online"] = self.online.cursor()
         fn = save_checkpoint(self.path, self.learner, self.name,
                              meta=self.meta, step=total_rounds,
                              cursor=cursor, fingerprint=self.fingerprint)
@@ -204,10 +218,13 @@ class TrainCheckpointer:
             raise ValueError(
                 f"--resume {fn!r}: checkpoint was written by the "
                 f"{cursor.get('entry')!r} entrypoint, this is {self.entry!r}")
-        self.batcher.restore_cursor(cursor["data"], cursor["in_epoch"])
+        if self.batcher is not None and cursor.get("data") is not None:
+            self.batcher.restore_cursor(cursor["data"], cursor["in_epoch"])
         if "buffered" in cursor and hasattr(self.learner,
                                             "restore_event_cursor"):
             self.learner.restore_event_cursor(cursor["buffered"])
+        if self.online is not None and "online" in cursor:
+            self.online.restore_cursor(cursor["online"])
         if self.log:
             print(f"resumed from {fn}: epoch {cursor['epoch']}, "
                   f"round {cursor['total_rounds']}", flush=True)

@@ -34,7 +34,7 @@ def test_registry_covers_every_row():
     a row cannot exist in one mode and be silently skipped by the
     other."""
     names = [n for n, _ in bench._bench_rows()]
-    assert len(names) == len(set(names)) == 31
+    assert len(names) == len(set(names)) == 33
     for must in ("cifar10_resnet9_fed_rounds_per_sec",
                  "cifar10_resnet9_per_worker_sketch_ab",
                  "gpt2_fetchsgd_per_worker_sketch_ab",
@@ -60,7 +60,9 @@ def test_registry_covers_every_row():
                  "gpt2_decode_speculative_personalized_ab",
                  "serve_personalized_admission_overhead",
                  "gpt2_decode_tp_tokens_per_sec_ab",
-                 "serve_disagg_decode_latency_ab"):
+                 "serve_disagg_decode_latency_ab",
+                 "gpt2_online_swap_latency",
+                 "gpt2_online_acceptance_drift_ab"):
         assert must in names
 
 

@@ -210,6 +210,19 @@ def test_main_emits_json_and_exits_zero_despite_failed_metrics(
                             "unified_decode_step_p50_ms": 5.0,
                             "disagg_decode_step_p50_ms": 5.2,
                             "prefill_slots": 2}))
+    monkeypatch.setattr(
+        bench, "bench_online_swap_latency",
+        lambda **kw: (45.0, {"swap_to_serving_p50_ms": 45.0,
+                             "swap_to_serving_p99_ms": 80.0,
+                             "n_swaps": 6, "drained_total": 48,
+                             "resubmitted_total": 48, "dirty_swaps": 0,
+                             "paged_step_cache": 1,
+                             "paged_insert_cache": 1}))
+    monkeypatch.setattr(
+        bench, "bench_online_acceptance_drift_ab",
+        lambda **kw: (0.62, {"gamma": 4, "slots": 8,
+                             "acceptance_pre_swap": 1.0,
+                             "acceptance_since_swap_eps0.08": 0.62}))
 
     def dead(*a, **k):
         raise RuntimeError("UNAVAILABLE: tunnel read body")
@@ -243,6 +256,8 @@ def test_main_emits_json_and_exits_zero_despite_failed_metrics(
     assert "serve_personalized_admission_overhead" in metrics
     assert "gpt2_decode_tp_tokens_per_sec_ab" in metrics
     assert "serve_disagg_decode_latency_ab" in metrics
+    assert "gpt2_online_swap_latency" in metrics
+    assert "gpt2_online_acceptance_drift_ab" in metrics
     # the dead metrics are absent from the numbers but present in errors
     assert "gpt2_fetchsgd_sketch_rounds_per_sec" not in metrics
     failed = {e["metric"] for e in out["errors"]}
