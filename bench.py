@@ -908,6 +908,111 @@ def bench_buffered_rounds(n_rounds=8):
     }
 
 
+def bench_buffered_mesh_rounds(n_rounds=8, dp=2):
+    """Mesh-native buffered aggregation A/B (federated/buffer.py over
+    the 'clients' mesh axis): the fault-free lock-step program and the
+    split cohort -> sharded-deposit -> staleness-apply chain run dp-way
+    data-parallel vs the same config single-chip. The deposit's slot
+    rows are pinned sharded over 'clients' (buffered_mesh audit), so
+    the buffer never materializes a replicated (M, d) slab — the
+    capacity win; on one host the time ratio should be ~flat, which is
+    the number this row pins. The faulted arm adds the host event loop
+    (heap + per-arrival deposit dispatches) with heterogeneous
+    per-client k, reported as the delta over the dp lock-step time.
+
+    Dry-run traces the dp-sharded programs via eval_shape — the
+    sharding_constraint annotations land in the jaxpr (the
+    buffered_mesh audit's subject). Degrades to mesh=None when the
+    process has a single device."""
+    import jax
+    import jax.numpy as jnp
+
+    from commefficient_tpu.config import FedConfig
+    from commefficient_tpu.federated.buffer import (BufferedFedLearner,
+                                                    init_buffer)
+    from commefficient_tpu.federated.faults import FaultModel
+    from commefficient_tpu.federated.losses import make_cv_loss
+    from commefficient_tpu.models import ResNet9
+    from commefficient_tpu.parallel.mesh import make_mesh
+
+    W, B, N = 4, 16, 12
+    model = ResNet9(num_classes=10, dtype="bfloat16")
+    rng = np.random.RandomState(0)
+    images = jnp.asarray(rng.randn(W, B, 32, 32, 3).astype(np.float32))
+    targets = jnp.asarray(rng.randint(0, 10, (W, B)).astype(np.int32))
+    mask = jax.device_put(jnp.ones((W, B), jnp.float32))
+    batch = (jax.device_put(images), jax.device_put(targets))
+    mesh = make_mesh(dp) if jax.device_count() >= dp else None
+
+    def make_learner(mesh_, fault_model=None, k_dist=None):
+        cfg = FedConfig(mode="local_topk", k=50_000, error_type="local",
+                        local_momentum=0.9, virtual_momentum=0,
+                        num_workers=W, num_clients=N, lr_scale=0.1,
+                        server_mode="buffered",
+                        staleness_alpha=0.5 if fault_model else 0.0,
+                        client_k_dist=k_dist or "")
+        kw = {"fault_model": fault_model} if fault_model else {}
+        return BufferedFedLearner(model, cfg, make_cv_loss(model), None,
+                                  jax.random.PRNGKey(0),
+                                  np.asarray(images[0][:1]),
+                                  mesh=mesh_, **kw)
+
+    def ids_fn(r):
+        return (np.arange(W) + r * W) % N
+
+    if DRY_RUN:
+        ln = make_learner(mesh)
+        ids = jnp.asarray(ids_fn(0), jnp.int32)
+        lr, key = jnp.float32(0.1), jax.random.PRNGKey(0)
+        out = jax.eval_shape(ln._lockstep, ln.state, ids, batch, mask,
+                             lr, key)
+        M = ln.cfg.effective_buffer_m
+
+        def full(state, ids_, cols, m, lr_, rng_):
+            contrib, _ = ln._cohort.raw(state, ids_, cols, m, lr_, rng_)
+            buf = init_buffer(contrib, M, ln.cfg.num_clients)
+            buf = ln._deposit.raw(buf, contrib,
+                                  jnp.ones((W,), jnp.bool_))
+            return ln._apply.raw(state.replace(buffer=buf), lr_, rng_)
+
+        jax.eval_shape(full, ln.state, ids, batch, mask, lr, key)
+        return {"dry_run": "ok", "dp": 1 if mesh is None else dp,
+                "out_leaves": len(jax.tree.leaves(out))}, {}
+
+    if mesh is None:
+        return None     # single-device process: nothing to A/B
+
+    def timed_rounds(ln):
+        ln.finalize_round_metrics(
+            ln.train_round_async(ids_fn(0), batch, mask))  # compile
+        ln.train_round_async(ids_fn(1), batch, mask)       # warm
+        t0 = time.perf_counter()
+        raw = None
+        for r in range(n_rounds):
+            raw = ln.train_round_async(ids_fn(2 + r), batch, mask)
+        ln.finalize_round_metrics(raw)
+        return (time.perf_counter() - t0) / n_rounds
+
+    single_t = timed_rounds(make_learner(None))
+    dp_t = timed_rounds(make_learner(mesh))
+
+    fm = FaultModel(1, N, straggler_frac=0.25, straggler_mult=5.0,
+                    dropout_prob=0.1, crash_prob=0.05)
+    ln_f = make_learner(mesh, fault_model=fm, k_dist="uniform:0.5,1.0")
+    faulted_t = timed_rounds(ln_f)
+    ln_f.flush_faults()
+
+    breakdown = {
+        "round_lockstep_single_ms": round(single_t * 1e3, 1),
+        f"round_lockstep_dp{dp}_ms": round(dp_t * 1e3, 1),
+        f"cohort_faulted_hetk_dp{dp}_ms": round(faulted_t * 1e3, 1),
+        "event_loop_overhead_ms": round((faulted_t - dp_t) * 1e3, 1),
+        "faulted_sim_time": round(ln_f.sim_time, 2),
+        **{f"faulted_{k}": v for k, v in ln_f.fault_stats.items()},
+    }
+    return round(dp_t / single_t, 4), breakdown
+
+
 def bench_checkpoint_overhead(every_rounds=100):
     """Crash-consistent checkpoint round trip (utils/checkpoint.py v3):
     atomic save (temp file + fsync + rename + digest), digest verify,
@@ -2475,6 +2580,8 @@ def _bench_rows():
          lambda: bench_client_store_sketched_codec()),
         ("buffered_fedbuff_round_overhead",
          lambda: bench_buffered_rounds()),
+        ("buffered_mesh_round_overhead_ab",
+         lambda: bench_buffered_mesh_rounds()),
         ("checkpoint_save_restore_overhead",
          lambda: bench_checkpoint_overhead()),
         ("gpt2_decode_tokens_per_sec_chip_b1",
@@ -2733,6 +2840,18 @@ def main():
                     "transactional load, with the per-round amortization "
                     "at --checkpoint_every_rounds=100"})
         if ckpt is not None else None)
+    bmesh_ab = res["buffered_mesh_round_overhead_ab"]
+    add("buffered_mesh_round_overhead_ab",
+        round(bmesh_ab[0], 4) if bmesh_ab is not None else None,
+        "time_ratio_x",
+        dict(bmesh_ab[1], **{
+            "note": "buffered lock-step round on the dp-way 'clients' "
+                    "mesh vs single-chip, same config (bitwise at α=0 — "
+                    "tests/test_buffered_mesh.py); ~flat by design, the "
+                    "win is the sharded slot buffer (no replicated (M, d) "
+                    "slab — buffered_mesh audit); the faulted arm prices "
+                    "the event loop + heterogeneous per-client k"})
+        if bmesh_ab is not None else None)
     for bsz in (1, 8, 64):
         dec = res[f"gpt2_decode_tokens_per_sec_chip_b{bsz}"]
         add(f"gpt2_decode_tokens_per_sec_chip_b{bsz}",

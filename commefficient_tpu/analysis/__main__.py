@@ -24,7 +24,8 @@ def main(argv=None) -> int:
                     "retrace / dtype / prng)")
     parser.add_argument("--target", default="all",
                         choices=["round", "round_bucketed", "sketch_batched",
-                                 "buffered", "client_store", "gpt2",
+                                 "buffered", "buffered_mesh",
+                                 "client_store", "gpt2",
                                  "attention", "sketch", "decode",
                                  "decode_paged", "decode_paged_quant",
                                  "decode_speculative", "serve_multihost",

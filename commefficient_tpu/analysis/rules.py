@@ -523,6 +523,96 @@ class ShardedPoolRule:
 
 
 # --------------------------------------------------------------------------
+# sharded buffered-aggregation slots (mesh-native FedBuff)
+# --------------------------------------------------------------------------
+
+
+class ShardedBufferRule:
+    """The buffered server's slot arrays stay sharded over the client
+    axis (parallel/mesh.buffer_state_shardings): each data-parallel
+    shard owns its own slot rows of the W-slot cohort contribution and
+    the M-slot server buffer, so no ``(W, d)`` or ``(M, d)`` aval is
+    ever replicated. The layout is pinned in-program inside the deposit
+    chain with ``with_sharding_constraint`` (federated/buffer.py
+    ``_pin``), which traces to ``sharding_constraint`` eqns whose
+    ``sharding`` param carries the PartitionSpec — the auditable
+    artifact this rule walks.
+
+    Every slot-leading constraint — any aval whose leading dim is W or
+    M (row leaves ``(slot, d)``, sketch tables ``(slot, r, c)``, slot
+    scalars ``(slot,)``) — must put the client axis at the slot index.
+    A REPLICATED spec on a slot-leading aval is the mutation arm's
+    all-gather layout: GSPMD would materialize every shard's slot rows
+    on every device, exactly the O(M·d)-per-shard HBM and per-deposit
+    collective the sharded buffer exists to remove. And the rule
+    requires at least one slot-ROW constraint (rank >= 2) per slot
+    width: zero row pins means the layout is unpinned and GSPMD is
+    free to replicate (the scalar ``count`` mirror is legitimately
+    replicated, which is why bare () avals are ignored).
+
+    ``W`` and ``M`` are constructor arguments, NOT audit dims: binding
+    ``W`` in ``dims`` would arm the footprint rule's (W, d) ban, which
+    must stay off — local modes legitimately own per-sampled-client
+    (W, d) state rows (same reasoning as BucketedTransmitRule).
+    """
+
+    name = "sharded_buffer"
+
+    def __init__(self, axis: str = "clients", W: int = 0, M: int = 0):
+        if not (W and M):
+            raise ValueError("ShardedBufferRule needs the cohort slot "
+                             "width W and buffer slot width M")
+        self.axis = axis
+        self.W = int(W)
+        self.M = int(M)
+
+    def check(self, sites: Sequence[EqnSite], stats: WalkStats,
+              dims: dict) -> RuleReport:
+        report = RuleReport(
+            rule=self.name, ok=True,
+            notes=f"slot-leading (W={self.W} | M={self.M}, ...) "
+                  f"sharding constraints must shard slots along "
+                  f"'{self.axis}'")
+        lead_dims = {self.W, self.M}
+        rows_seen = {self.W: 0, self.M: 0}
+        checked = 0
+        for site in sites:
+            report.checked_eqns += 1
+            if site.primitive != "sharding_constraint":
+                continue
+            for var in site.eqn.outvars:
+                aval = getattr(var, "aval", None)
+                shape = tuple(getattr(aval, "shape", ()))
+                if not shape or shape[0] not in lead_dims:
+                    continue
+                checked += 1
+                if len(shape) >= 2:
+                    rows_seen[shape[0]] += 1
+                sharding = site.eqn.params.get("sharding")
+                spec = getattr(sharding, "spec", None)
+                if self.axis not in ShardedPoolRule._spec_entry(spec, 0):
+                    report.ok = False
+                    report.violations.append(Violation(
+                        rule=self.name, path=site.path,
+                        primitive=site.primitive, shape=shape,
+                        message=f"slot-leading aval constrained to "
+                                f"{spec} — slots not sharded along "
+                                f"'{self.axis}' (a replicated buffer is "
+                                f"the all-gather GSPMD would "
+                                f"materialize on every shard)"))
+        missing = [s for s, n in rows_seen.items() if n == 0]
+        if missing:
+            report.ok = False
+            report.violations.append(Violation(
+                rule=self.name, path="", primitive="<absent>",
+                message=f"no sharding_constraint pins slot rows of "
+                        f"width(s) {missing} — nothing stops the "
+                        f"buffer falling back to replicated placement"))
+        report.notes += f"; {checked} slot constraints checked"
+        return report
+
+
+# --------------------------------------------------------------------------
 # dtype policy
 # --------------------------------------------------------------------------
 

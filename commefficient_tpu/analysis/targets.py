@@ -26,7 +26,8 @@ from .report import AuditReport
 from .retrace import check_retrace
 from .rules import (DEFAULT_PATTERNS, BatchedSketchRule,
                     BucketedTransmitRule, FootprintRule, RuleReport,
-                    ShapePattern, ShardedPoolRule, TransferRule)
+                    ShapePattern, ShardedBufferRule, ShardedPoolRule,
+                    TransferRule, Violation)
 from .walker import walk
 
 
@@ -350,6 +351,147 @@ def buffered_target() -> AuditTarget:
         trace=trace,
         dims={"num_clients": n_clients, "d": d},
         rules=(FootprintRule(DEFAULT_PATTERNS), TransferRule()),
+        retrace=retrace)
+
+
+def buffered_mesh_target(mutate: bool = False) -> AuditTarget:
+    """The mesh-native buffered server: the split cohort -> deposit ->
+    apply chain as pjit programs over a dp=2 ``clients`` mesh
+    (federated/buffer.py with ``mesh=``).
+
+    The multi-chip contract is that every slot-leading buffer aval is
+    SHARDED along the clients axis — each shard owns its own rows of
+    the W-slot cohort contribution and the M-slot server buffer
+    (parallel/mesh.buffer_state_shardings), so no ``(W, d)`` or
+    ``(M, d)`` aval is ever replicated. Inside the traced chain that
+    contract is visible as the deposit path's ``sharding_constraint``
+    eqns (buffer.py ``_pin``) pinning every slot-leading aval to a
+    spec with the clients axis at the slot index; a REPLICATED
+    constraint is the all-gather GSPMD would materialize on every
+    shard (dp x the buffer HBM plus a per-deposit collective over all
+    slot rows), and ZERO row pins means the layout is unpinned and
+    GSPMD is free to pick exactly that. The transfer rule proves the
+    event loop stays host-side: no callback crosses into the jitted
+    chain. The retrace guard drives a REAL dp=2 event loop —
+    seeded FaultModel stragglers/dropouts, heap-ordered deposits,
+    buffer-full and flush-partial applies, plus a fault-free lockstep
+    learner — and asserts all four programs' compile caches sit at
+    ONE entry (the ``buffer=None`` cohort input and the committed
+    slot-sharded buffer placement are what keep them there).
+
+    ``mutate=True`` re-pins every deposited buffer leaf to the
+    replicated spec ``P()`` between deposit and apply — the layout a
+    replicated-buffer reintroduction would produce — and the audit
+    must FAIL on it (tests/test_buffered_mesh.py pins this).
+
+    Needs ``jax.device_count() >= 2`` (the CLI forces 8 virtual CPU
+    devices; tests/conftest.py does the same).
+    """
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as PSpec
+
+    from commefficient_tpu.config import FedConfig
+    from commefficient_tpu.federated.buffer import (BufferedFedLearner,
+                                                    init_buffer)
+    from commefficient_tpu.federated.faults import FaultModel
+    from commefficient_tpu.federated.losses import make_cv_loss
+    from commefficient_tpu.models import TinyMLP
+
+    if jax.device_count() < 2:
+        raise RuntimeError(
+            "buffered_mesh needs >= 2 devices for the dp=2 mesh — on "
+            "CPU set XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+            "BEFORE jax is imported")
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("clients",))
+    w, n_clients, m_slots = 2, 8, 4
+    model = TinyMLP(num_classes=2, hidden=4)
+    cfg = FedConfig(weight_decay=0, num_workers=w, num_clients=n_clients,
+                    lr_scale=0.05, server_mode="buffered",
+                    buffer_m=m_slots, staleness_alpha=0.5,
+                    client_quarantine=True, quarantine_rounds=3,
+                    **ROUND_CFGS["local_topk"])
+
+    def make_learner(fault_model=None):
+        return BufferedFedLearner(
+            model, cfg, make_cv_loss(model), None, jax.random.PRNGKey(1),
+            np.zeros((1, 8), np.float32), mesh=mesh,
+            fault_model=fault_model)
+
+    ln = make_learner()
+    d = int(ln.state.last_changed.shape[0])
+    batch, mask = _round_batch(w)
+    ids = jnp.arange(w, dtype=jnp.int32)
+    take = jnp.ones((w,), bool)
+
+    def chain(state, ids, batch, mask, lr, rng, take):
+        # the fault path's real program sequence: cohort against the
+        # current weights, deposit of the arrival take-mask into an
+        # empty M-slot buffer, staleness-weighted apply
+        contrib, cm = ln._cohort.raw(state.replace(buffer=None), ids,
+                                     batch, mask, lr, rng)
+        buf = ln._deposit.raw(init_buffer(contrib, m_slots,
+                                          cfg.num_clients), contrib, take)
+        if mutate:
+            rep = NamedSharding(mesh, PSpec())
+            buf = jax.tree.map(
+                lambda x: jax.lax.with_sharding_constraint(x, rep), buf)
+        new_state, am = ln._apply.raw(state.replace(buffer=buf), lr, rng)
+        return new_state, cm, am
+
+    def trace():
+        return jax.make_jaxpr(chain)(
+            ln.state, ids, batch, mask, jnp.float32(0.05),
+            jax.random.PRNGKey(0), take)
+
+    def retrace():
+        report = RuleReport(rule="retrace", ok=True)
+
+        def flag(msg):
+            report.ok = False
+            report.violations.append(Violation(
+                rule="retrace", path="", primitive="jit", message=msg))
+
+        fm = FaultModel(7, n_clients, straggler_frac=0.25,
+                        dropout_prob=0.1)
+        ln_f = make_learner(fault_model=fm)
+        ln_l = make_learner()            # fault-free: fused lockstep
+        rs = np.random.RandomState(3)
+        for _ in range(6):
+            ids_i = rs.choice(n_clients, w, replace=False)
+            b, m = _round_batch(w, rs)
+            ln_f.train_round_async(ids_i, b, m)
+            ln_l.train_round_async(ids_i, b, m)
+        ln_f.flush_faults()
+        stats = ln_f.fault_stats
+        if stats["applies"] < 1 or stats["arrivals"] < 1:
+            flag(f"fault-model drive exercised no deposit/apply "
+                 f"({stats}) — the cache assertions would be vacuous")
+        for name, fn in (("cohort", ln_f._cohort),
+                         ("deposit", ln_f._deposit),
+                         ("apply", ln_f._apply),
+                         ("lockstep", ln_l._lockstep)):
+            n = fn._cache_size()
+            if n != 1:
+                flag(f"{name} compile cache at {n} entries (want "
+                     f"exactly 1) after the driven dp=2 event loop")
+        report.checked_eqns = 12
+        report.notes = (f"6 fault-model cohorts + flush and 6 lockstep "
+                        f"cohorts on the dp=2 mesh; fault_stats {stats}")
+        return report
+
+    return AuditTarget(
+        name="buffered_mesh/chain" + ("(mutated)" if mutate else ""),
+        description="mesh-native buffered cohort->deposit->apply chain "
+                    "(dp=2); every slot-leading buffer aval must be "
+                    "pinned slot-sharded along 'clients' — replicated "
+                    "slot rows (the all-gather layout) are banned"
+                    + (" [replicated-buffer mutation — must fail]"
+                       if mutate else ""),
+        trace=trace,
+        dims={"num_clients": n_clients, "d": d},
+        rules=(FootprintRule(DEFAULT_PATTERNS),
+               ShardedBufferRule("clients", W=w, M=m_slots),
+               TransferRule()),
         retrace=retrace)
 
 
@@ -1270,6 +1412,8 @@ def build_targets(name: str) -> list:
         return [sketch_target()]
     if name == "buffered":
         return [buffered_target()]
+    if name == "buffered_mesh":
+        return [buffered_mesh_target()]
     if name == "round_bucketed":
         return [round_bucketed_target("local_topk"),
                 round_bucketed_target("sketch")]
@@ -1292,7 +1436,9 @@ def build_targets(name: str) -> list:
     if name == "all":
         return (build_targets("round") + build_targets("round_bucketed")
                 + build_targets("sketch_batched")
-                + build_targets("buffered") + build_targets("client_store")
+                + build_targets("buffered")
+                + build_targets("buffered_mesh")
+                + build_targets("client_store")
                 + build_targets("gpt2") + build_targets("attention")
                 + build_targets("sketch") + build_targets("decode")
                 + build_targets("decode_paged")
@@ -1301,6 +1447,7 @@ def build_targets(name: str) -> list:
                 + build_targets("serve_multihost")
                 + build_targets("online_loop"))
     raise ValueError(f"unknown audit target {name!r} (round|round_bucketed|"
-                     f"sketch_batched|buffered|client_store|gpt2|attention|"
-                     f"sketch|decode|decode_paged|decode_speculative|"
-                     f"decode_paged_quant|serve_multihost|online_loop|all)")
+                     f"sketch_batched|buffered|buffered_mesh|client_store|"
+                     f"gpt2|attention|sketch|decode|decode_paged|"
+                     f"decode_speculative|decode_paged_quant|"
+                     f"serve_multihost|online_loop|all)")

@@ -70,6 +70,20 @@ class FedConfig:
     # compute. Linearity of the sketch (PAPER.md) makes the bucketed table
     # bit-compatible with the monolithic one; see docs/ROOFLINE.md Round 7.
     grad_buckets: int = 1
+    # Client-resource heterogeneity for mode='local_topk' (federated
+    # dropout-style partial participation): '' = every client transmits
+    # the provisioned k; 'uniform:lo,hi' draws each client a CHRONIC
+    # budget k_i = round(U[lo,hi] * k) (>= 1) from the fault model's
+    # keyed-Philox scheme (federated/faults.py _TAG_K — order-independent
+    # and resumable by construction). The device still selects the
+    # provisioned top-k, then masks it down to the client's own k_i
+    # largest-magnitude coordinates; masked coordinates stay in the
+    # error-feedback row and are re-transmitted when they survive a later
+    # selection. The PR 11 sparse codec stores variable-k rows natively,
+    # and byte accounting keeps charging the provisioned k — the sparse
+    # wire format ships (k,) idx/val slots regardless of how many are
+    # nonzero.
+    client_k_dist: str = ""
     # 0.0 = exact top-k selection (reference parity). Setting a recall
     # target in (0, 1] switches every top-k in the pipeline (unsketch,
     # true_topk, local_topk, topk_down) to jax.lax.approx_max_k — the
@@ -413,10 +427,23 @@ class FedConfig:
         if self.server_mode == "buffered":
             if self.effective_buffer_m < 1:
                 raise ValueError("buffered server_mode needs buffer_m >= 1")
-            if self.client_state_offload:
-                raise ValueError("server_mode='buffered' is incompatible "
-                                 "with client_state_offload (contribution "
-                                 "slots already buffer the sampled rows)")
+            # buffered + client_state_offload is SUPPORTED since the mesh-
+            # native buffer refactor: cohorts gather sampled rows from the
+            # host arenas exactly like the sync round, updated rows ride the
+            # contribution slots, and the host writes them back at apply
+            # time (deferred writeback — the same visibility semantics as
+            # device-resident buffered state, where rows also only land in
+            # client state when the buffer applies).
+        if self.client_k_dist:
+            if self.mode != "local_topk":
+                raise ValueError(
+                    "--client_k_dist draws a per-client transmit budget "
+                    "k_i <= k, which only mode='local_topk' spends (got "
+                    f"mode={self.mode!r}); sketch capacity heterogeneity "
+                    "is a different axis and is not implemented")
+            # fail at validate() time, not first-round time
+            from commefficient_tpu.federated.faults import parse_k_dist
+            parse_k_dist(self.client_k_dist)
         # parse-time invariants, reference utils.py:225-228
         if self.mode == "fedavg":
             if self.local_batch_size != -1:
@@ -438,6 +465,12 @@ class FedConfig:
             raise ValueError("local_topk supports error_type in {none, local}")
         if self.mode == "true_topk" and self.error_type != "virtual":
             raise ValueError("true_topk requires error_type == 'virtual'")
+
+    @property
+    def client_k_active(self) -> bool:
+        """Whether the round programs take a per-cohort (W,) client budget
+        argument (validate() guarantees local_topk when set)."""
+        return bool(self.client_k_dist)
 
     @property
     def effective_buffer_m(self) -> int:

@@ -206,6 +206,9 @@ class FedLearner:
                     lr_scale_vec, (0, self.cfg.grad_dim - d_logical),
                     constant_values=1.0)
         self.lr_scale_vec = lr_scale_vec
+        # --client_k_dist: chronic per-client budget draws, memoized so a
+        # client costs one Philox draw per run (faults.cohort_client_ks)
+        self._client_k_memo = {}
         self.rounds_done = 0
         self.total_download_bytes = 0.0
         self.total_upload_bytes = 0.0
@@ -270,6 +273,20 @@ class FedLearner:
     def lr_at(self, t: float) -> float:
         return float(self.lr_schedule(t))
 
+    def _client_ks(self, client_ids):
+        """Device (W,) int32 per-client transmit budgets under
+        ``--client_k_dist`` — drawn host-side from the seeded keyed-Philox
+        stream (pure function of (cfg.seed, client): order-independent
+        and resumable), placed like the ids so the guarded dispatch stays
+        transfer-free."""
+        from commefficient_tpu.federated.faults import cohort_client_ks
+        ks = jnp.asarray(cohort_client_ks(
+            self.cfg.seed, np.asarray(client_ids), self.cfg.k,
+            self.cfg.client_k_dist, memo=self._client_k_memo))
+        if self.mesh is not None:
+            ks = jax.device_put(ks, self._batch_sh[0])
+        return ks
+
     def _replicate(self, *xs):
         """Explicitly replicate per-call args (lr scalar, round rng, eval
         batch) across the mesh. Under the dispatch transfer guard the jit
@@ -316,13 +333,15 @@ class FedLearner:
                  else lr * self.lr_scale_vec)
         if self.mesh is not None:
             lr_in, round_rng = self._replicate(lr_in, round_rng)
+        ks = ((self._client_ks(client_ids),) if self.cfg.client_k_active
+              else ())
         if self._offload:
             ids_np = np.asarray(client_ids).astype(np.int64)
             valid = np.asarray(mask).any(axis=1)
             rows = self._offload_pipe.gather(ids_np)
             with _dispatch_guard():
                 self.state, out_rows, metrics = self._round(
-                    self.state, rows, ids, cols, m, lr_in, round_rng)
+                    self.state, rows, ids, cols, m, lr_in, round_rng, *ks)
             self._offload_pipe.push(ids_np, valid, out_rows)
             if next_client_ids is not None:
                 self._offload_pipe.prefetch(
@@ -330,7 +349,7 @@ class FedLearner:
         else:
             with _dispatch_guard():
                 self.state, metrics = self._round(self.state, ids, cols, m,
-                                                  lr_in, round_rng)
+                                                  lr_in, round_rng, *ks)
         self.rounds_done += 1
         metrics["lr"] = lr
         return metrics
@@ -377,15 +396,19 @@ class FedLearner:
         if getattr(self, "_rounds_scan", None) is None:
             raw = self._round.raw
             scale_vec = self.lr_scale_vec
+            het_k = self.cfg.client_k_active
 
-            def scan_rounds(state, ids_k, cols_k, mask_k, lrs, rngs):
+            def scan_rounds(state, ids_k, cols_k, mask_k, lrs, rngs,
+                            *ks_k):
                 def body(st, per_round):
-                    ids, cols, m, lr, rng = per_round
+                    ids, cols, m, lr, rng = per_round[:5]
                     lr_in = lr if scale_vec is None else lr * scale_vec
-                    return raw(st, ids, cols, m, lr_in, rng)
+                    return raw(st, ids, cols, m, lr_in, rng,
+                               *per_round[5:])
 
                 return jax.lax.scan(
-                    body, state, (ids_k, cols_k, mask_k, lrs, rngs))
+                    body, state, (ids_k, cols_k, mask_k, lrs, rngs)
+                    + ks_k)
 
             if self.mesh is None:
                 self._rounds_scan = jax.jit(scan_rounds, donate_argnums=0)
@@ -399,7 +422,8 @@ class FedLearner:
                 self._rounds_scan = jax.jit(
                     scan_rounds, donate_argnums=0,
                     in_shardings=(state_sh, ids_sh, cols_sh, mask_sh,
-                                  None, None),
+                                  None, None)
+                    + ((ids_sh,) if het_k else ()),
                     out_shardings=(state_sh, None))
         return self._rounds_scan
 
@@ -437,6 +461,17 @@ class FedLearner:
         rngs = jnp.stack(round_rngs)
         cols = tuple(jnp.asarray(t) for t in batches)
         m = jnp.asarray(masks, jnp.float32)
+        ks = ()
+        if self.cfg.client_k_active:
+            # stacked (K, W) budgets, one row per scanned round — the same
+            # chronic per-client draws train_round_async would make, so
+            # scanned and per-round trajectories stay bit-identical
+            from commefficient_tpu.federated.faults import cohort_client_ks
+            ks = (jnp.asarray(np.stack([
+                cohort_client_ks(self.cfg.seed, row, self.cfg.k,
+                                 self.cfg.client_k_dist,
+                                 memo=self._client_k_memo)
+                for row in np.asarray(client_ids)])),)
         if self.mesh is not None:
             from commefficient_tpu.parallel.mesh import \
                 stacked_batch_shardings
@@ -444,11 +479,13 @@ class FedLearner:
             ids = jax.device_put(ids, ids_sh)
             cols = jax.device_put(cols, cols_sh)
             m = jax.device_put(m, mask_sh)
+            if ks:
+                ks = (jax.device_put(ks[0], ids_sh),)
             lrs, rngs = self._replicate(lrs, rngs)
         scan_fn = self._rounds_scan_fn()
         with _dispatch_guard():
             self.state, metrics = scan_fn(self.state, ids, cols, m, lrs,
-                                          rngs)
+                                          rngs, *ks)
         self.rounds_done += K
         metrics["lr"] = lrs_host   # host-known; keeps the dispatch async
         return metrics

@@ -41,13 +41,31 @@ contribution is excluded at apply (jnp.where — NaN * 0 is NaN) and its
 client benched for quarantine_rounds applies; only a post-exclusion
 server-side breach trips the sticky global abort.
 
-Single-chip by design: on a mesh use the sync round (this module
-raises). The host event loop itself is NOT training-only, though: it is
-externally steppable (``pump_events`` delivers due arrivals without
-dispatching a cohort), which is how the train-while-serve driver
-(commefficient_tpu/online/loop.py) interleaves buffered cohorts with
-the continuous-batching server's decode steps on one host loop — two
-program families sharing a process, never a jit program.
+Mesh-native: with a ``--mesh``, all four programs are pjit programs over
+the ``clients`` axis — cohort compute shards the W sampled clients across
+data-parallel devices exactly as the sync round does, contributions
+deposit into a SHARDED buffer (every slot-leading leaf splits its slot
+dim over the axis, so each shard owns its own slot rows and no ``(W, d)``
+or ``(M, d)`` aval is ever replicated — the ``buffered_mesh`` graft-audit
+target enforces this), and the staleness-weighted apply's slot reduction
+is the same implicit psum the sync round's worker reduce lowers to. The
+HOST event loop stays exactly where it was: heap order, fate draws, and
+take-masks are device-count-independent, which is what keeps the event
+cursor SIGKILL-resumable on a mesh (docs/ROBUSTNESS.md). The loop itself
+is NOT training-only: it is externally steppable (``pump_events``
+delivers due arrivals without dispatching a cohort), which is how the
+train-while-serve driver (commefficient_tpu/online/loop.py) interleaves
+buffered cohorts with the continuous-batching server's decode steps on
+one host loop — two program families sharing a process, never a jit
+program.
+
+Host-offloaded client state (cfg.client_state_offload) composes too:
+cohorts gather the sampled rows from the per-shard host arenas through
+the owner-routing offload pipeline (exactly like the sync round's
+offload path), updated rows ride the contribution slots, and the host
+writes them back into the arenas at APPLY time — deferred writeback,
+the same visibility semantics as device-resident buffered state, where
+rows also land in client state only when the buffer applies.
 """
 
 from __future__ import annotations
@@ -73,19 +91,35 @@ from commefficient_tpu.federated.state import BufferState, ClientState
 
 def build_buffer_programs(apply_loss: Callable, unflatten: Callable,
                           cfg: FedConfig,
-                          trainable_mask: Optional[jax.Array] = None):
+                          trainable_mask: Optional[jax.Array] = None,
+                          mesh=None):
     """Build the (cohort, deposit, apply) jitted programs for this config.
 
     Returns ``(cohort_fn, deposit_fn, apply_fn, lockstep_fn)``:
 
-        cohort_fn(state, ids (W,), batch (W,B,...), mask (W,B), lr, rng)
+        cohort_fn(state, [rows,] ids (W,), batch (W,B,...), mask (W,B),
+                  lr, rng[, client_ks (W,)])
             -> (BufferState with W slots, cohort metric dict)
         deposit_fn(buffer (M slots), contrib (W slots), take (W,) bool)
             -> new buffer     [buffer donated]
         apply_fn(state, lr, rng) -> (new state, apply metric dict)
                                   [state donated]
-        lockstep_fn(state, ids, batch, mask, lr, rng)
+        lockstep_fn(state, [rows,] ids, batch, mask, lr, rng[, client_ks])
             -> (new state, merged metric dict)   [state donated]
+
+    The optional arguments are static per-config: ``rows`` (a W-leading
+    encoded ClientState) appears iff client state is host-offloaded —
+    apply/lockstep then additionally return a ``(writeback_ids (M,),
+    writeback rows)`` element between state and metrics, the deferred
+    arena writeback the host pushes through its offload pipeline — and
+    ``client_ks`` appears iff cfg.client_k_dist is set.
+
+    With a ``mesh``, all four are pjit programs: state/buffer per
+    ``fed_state_shardings``/``buffer_state_shardings``, batch and
+    take-mask worker-sharded over the ``clients`` axis, lr/rng
+    replicated. The caller must pass the SAME mesh the learner's state is
+    sharded on; num_workers, num_clients AND buffer_m must divide the
+    axis (each shard owns its own slot rows).
 
     Each carries an un-donated ``.raw`` for analysis/ tracing.
     """
@@ -96,10 +130,14 @@ def build_buffer_programs(apply_loss: Callable, unflatten: Callable,
     M = cfg.effective_buffer_m
     # client rows live in codec-encoded storage (client_store.make_codec);
     # buffer SLOTS stay dense — M is small — and rows encode only on the
-    # scatter back into client state at apply
+    # scatter back into client state at apply (or, under offload, on the
+    # writeback rows handed to the host at apply)
     codec = make_codec(cfg)
     sketch = make_sketch(cfg) if cfg.mode == "sketch" else None
     is_fedavg = cfg.mode == "fedavg"
+    offload = cfg.client_state_offload and cfg.has_client_state
+    host_codec = offload and codec.host_side_offload
+    het_k = cfg.client_k_active
     # same linearity fast path as the sync round: sketch once per APPLY
     # instead of once per client when no per-worker nonlinearity exists
     sketch_after_aggregate = (cfg.mode == "sketch" and not cfg.do_dp
@@ -108,16 +146,51 @@ def build_buffer_programs(apply_loss: Callable, unflatten: Callable,
     if trainable_mask is not None:
         trainable_mask = jnp.asarray(trainable_mask, jnp.float32)
 
-    def one_client(ps_w, batch, mask, vel, err, stale, lr, rng):
+    if mesh is not None:
+        from commefficient_tpu.parallel.mesh import (
+            batch_shardings, buffer_state_shardings,
+            client_rows_shardings, fed_state_shardings)
+        n_shards = mesh.shape["clients"]
+        for name, val in (("num_workers", cfg.num_workers),
+                          ("num_clients", cfg.num_clients),
+                          ("buffer_m", M)):
+            if val % n_shards:
+                raise ValueError(
+                    f"{name} ({val}) must be divisible by the mesh "
+                    f"'clients' axis size ({n_shards}) — buffered slot "
+                    f"rows shard over that axis (each shard owns its "
+                    f"own slots)")
+        state_sh = fed_state_shardings(cfg, mesh)
+        buf_sh = buffer_state_shardings(cfg, mesh)
+        state_buf_sh = state_sh.replace(buffer=buf_sh)
+        ids_sh, cols_sh, mask_sh = batch_shardings(mesh)
+
+        def _pin(buf: BufferState) -> BufferState:
+            # in-program slot-sharding pins: the deposit chain is where a
+            # replicated (M, d)/(W, d) buffer aval would sneak in, and
+            # these constraints are what the buffered_mesh graft-audit
+            # rule keys on (analysis/rules.ShardedBufferRule). Deposit
+            # only — the fused lockstep stays constraint-free so XLA's
+            # fusion decisions match the sync round's (the bitwise
+            # lock-step contract).
+            return jax.tree.map(jax.lax.with_sharding_constraint,
+                                buf, buf_sh)
+    else:
+        def _pin(buf: BufferState) -> BufferState:
+            return buf
+
+    def one_client(ps_w, batch, mask, vel, err, stale, lr, rng, ck=None):
         if is_fedavg:
             return client_lib.fedavg_client_step(
                 apply_loss, unflatten, ps_w, batch, mask, lr, rng, cfg,
                 trainable_mask=trainable_mask)
         return client_lib.client_step(
             apply_loss, unflatten, ps_w, batch, mask, vel, err, stale,
-            rng, cfg, client_sketch, trainable_mask=trainable_mask)
+            rng, cfg, client_sketch, trainable_mask=trainable_mask,
+            client_k=ck)
 
-    def cohort_core(state: FedState, client_ids, batch, mask, lr, rng):
+    def cohort_core(state: FedState, rows, client_ids, batch, mask, lr,
+                    rng, client_ks=None):
         w = state.weights
         ids = client_ids
         W = ids.shape[0]
@@ -134,18 +207,33 @@ def build_buffer_programs(apply_loss: Callable, unflatten: Callable,
         stale_round = state.client_last_round[ids]
         counts = download_counts(state.last_changed, stale_round)   # (W,)
 
-        vels = gather_rows(state.clients.velocities, ids, codec)
-        errs = gather_rows(state.clients.errors, ids, codec)
-        stales = gather_rows(state.clients.weights, ids, codec)
+        if offload:
+            # sampled rows arrive host-gathered (owner-routed through the
+            # per-shard arenas), dense under a host-side codec — the same
+            # wire contract as round.round_core's offload branch
+            def _dec(enc):
+                if enc is None or host_codec:
+                    return enc
+                return codec.decode_rows(enc)
+            vels, errs, stales = (_dec(rows.velocities),
+                                  _dec(rows.errors),
+                                  _dec(rows.weights))
+        else:
+            vels = gather_rows(state.clients.velocities, ids, codec)
+            errs = gather_rows(state.clients.errors, ids, codec)
+            stales = gather_rows(state.clients.weights, ids, codec)
         rngs = jax.vmap(lambda i: jax.random.fold_in(rng, i))(ids)
-        out = jax.vmap(
-            one_client,
-            in_axes=(None, 0, 0,
-                     None if vels is None else 0,
-                     None if errs is None else 0,
-                     None if stales is None else 0,
-                     None, 0),
-        )(w, batch, mask, vels, errs, stales, lr, rngs)
+        axes = (None, 0, 0,
+                None if vels is None else 0,
+                None if errs is None else 0,
+                None if stales is None else 0,
+                None, 0)
+        if client_ks is not None:
+            out = jax.vmap(one_client, in_axes=axes + (0,))(
+                w, batch, mask, vels, errs, stales, lr, rngs, client_ks)
+        else:
+            out = jax.vmap(one_client, in_axes=axes)(
+                w, batch, mask, vels, errs, stales, lr, rngs)
 
         contrib = BufferState(
             transmit=out.transmit,
@@ -201,6 +289,7 @@ def build_buffer_programs(apply_loss: Callable, unflatten: Callable,
         drop out here, so the host's count mirror must re-read
         ``buf.count``. The caller guarantees popcount(take) <= M - count;
         overflow slots would silently OOB-drop."""
+        contrib = _pin(contrib)
         take_eff = jnp.logical_and(take, contrib.valid)
         ti = take_eff.astype(jnp.int32)
         slots = jnp.where(take_eff, buf.count + jnp.cumsum(ti) - 1,
@@ -211,7 +300,7 @@ def build_buffer_programs(apply_loss: Callable, unflatten: Callable,
                 return dst
             return dst.at[slots].set(src, mode="drop")
 
-        return BufferState(
+        return _pin(BufferState(
             transmit=put(buf.transmit, contrib.transmit),
             loss_sum=put(buf.loss_sum, contrib.loss_sum),
             metric_sums=put(buf.metric_sums, contrib.metric_sums),
@@ -225,7 +314,7 @@ def build_buffer_programs(apply_loss: Callable, unflatten: Callable,
             velocities=put(buf.velocities, contrib.velocities),
             errors=put(buf.errors, contrib.errors),
             weights=put(buf.weights, contrib.weights),
-        )
+        ))
 
     def apply_core(state: FedState, lr, rng):
         buf = state.buffer
@@ -303,14 +392,32 @@ def build_buffer_programs(apply_loss: Callable, unflatten: Callable,
             new_vels = jnp.where(support, 0.0, new_vels)
         scatter_ids = jnp.where(jnp.logical_and(contrib_b, ok), buf.cid,
                                 jnp.int32(num_clients))
-        new_clients = ClientState(
-            velocities=scatter_rows(state.clients.velocities,
-                                    scatter_ids, new_vels, codec),
-            errors=scatter_rows(state.clients.errors, scatter_ids,
-                                buf.errors, codec),
-            weights=scatter_rows(state.clients.weights, scatter_ids,
-                                 buf.weights, codec),
-        )
+        if offload:
+            # deferred arena writeback: rows ride the buffer slots dense
+            # and leave the program here, gated by the same contrib & ok
+            # mask as the device scatter (dropped slots carry the
+            # num_clients OOB sentinel id, which the host pipeline
+            # skips). Non-host codecs (sketched) re-encode in-program,
+            # the host writes the encoding verbatim.
+            def _enc(dense):
+                if dense is None:
+                    return None
+                return dense if host_codec else codec.encode_rows(dense)
+            writeback = (scatter_ids,
+                         ClientState(velocities=_enc(new_vels),
+                                     errors=_enc(buf.errors),
+                                     weights=_enc(buf.weights)))
+            new_clients = state.clients
+        else:
+            writeback = None
+            new_clients = ClientState(
+                velocities=scatter_rows(state.clients.velocities,
+                                        scatter_ids, new_vels, codec),
+                errors=scatter_rows(state.clients.errors, scatter_ids,
+                                    buf.errors, codec),
+                weights=scatter_rows(state.clients.weights, scatter_ids,
+                                     buf.weights, codec),
+            )
 
         # stamps are in APPLY (version) units, same axis the download
         # comparison runs on: a weight changed at version u was unseen by
@@ -383,31 +490,87 @@ def build_buffer_programs(apply_loss: Callable, unflatten: Callable,
                 jnp.logical_and(vmask, ~finite_b).astype(nf)) * okf
             ametrics["num_quarantined"] = jnp.sum(
                 (new_quarantine > 0).astype(jnp.int32))
+        if offload:
+            return new_state, writeback, ametrics
         return new_state, ametrics
 
-    def lockstep_core(state: FedState, client_ids, batch, mask, lr, rng):
+    def lockstep_core(state: FedState, rows, client_ids, batch, mask, lr,
+                      rng, client_ks=None):
         """cohort -> apply fused in ONE program, the no-fault-model path:
         every contribution arrives instantly and the server applies each
         cohort, so the transient W-slot buffer never leaves the jit
         (state.buffer stays None). Fusing matters beyond dispatch count:
         compiled as one program, XLA makes the same fusion decisions it
         makes for the sync round, which is what turns the M=W, alpha=0
-        equivalence from allclose into assert_array_equal."""
-        contrib, cm = cohort_core(state, client_ids, batch, mask, lr, rng)
+        equivalence from allclose into assert_array_equal — on a mesh as
+        much as single-chip (same shardings, same op structure, one jit;
+        no sharding constraints are pinned inside this path)."""
+        contrib, cm = cohort_core(state, rows, client_ids, batch, mask,
+                                  lr, rng, client_ks)
         W = client_ids.shape[0]
         st = state.replace(buffer=contrib.replace(count=jnp.int32(W)))
+        if offload:
+            new_state, wb, am = apply_core(st, lr, rng)
+            return new_state.replace(buffer=None), wb, {**cm, **am}
         new_state, am = apply_core(st, lr, rng)
         return new_state.replace(buffer=None), {**cm, **am}
 
-    # cohort is NOT donated: its inputs (state) stay live for deposit/apply
-    cohort_fn = jax.jit(cohort_core)
-    cohort_fn.raw = cohort_core
-    deposit_fn = jax.jit(deposit_core, donate_argnums=0)
+    # public signatures: rows / client_ks appear iff their feature is on
+    # (static per-config — ONE pytree structure per program, so each
+    # program compiles exactly once across the event loop)
+    if offload:
+        def cohort_pub(state, rows, ids, batch, mask, lr, rng, *ks):
+            return cohort_core(state, rows, ids, batch, mask, lr, rng,
+                               *ks)
+
+        def lockstep_pub(state, rows, ids, batch, mask, lr, rng, *ks):
+            return lockstep_core(state, rows, ids, batch, mask, lr, rng,
+                                 *ks)
+    else:
+        def cohort_pub(state, ids, batch, mask, lr, rng, *ks):
+            return cohort_core(state, None, ids, batch, mask, lr, rng,
+                               *ks)
+
+        def lockstep_pub(state, ids, batch, mask, lr, rng, *ks):
+            return lockstep_core(state, None, ids, batch, mask, lr, rng,
+                                 *ks)
+
+    if mesh is None:
+        # cohort is NOT donated: its inputs (state) stay live for
+        # deposit/apply
+        cohort_fn = jax.jit(cohort_pub)
+        deposit_fn = jax.jit(deposit_core, donate_argnums=0)
+        apply_fn = jax.jit(apply_core, donate_argnums=0)
+        lockstep_fn = jax.jit(lockstep_pub, donate_argnums=0)
+    else:
+        batch_in = (ids_sh, cols_sh, mask_sh)
+        rows_in = (client_rows_shardings(cfg, mesh),) if offload else ()
+        ks_in = (ids_sh,) if het_k else ()
+        slot_sh = buf_sh.cid   # any (M,)/(W,)-leading slot sharding
+        wb_out = (((slot_sh, client_rows_shardings(cfg, mesh)),)
+                  if offload else ())
+        cohort_fn = jax.jit(
+            cohort_pub,
+            in_shardings=(state_sh,) + rows_in + batch_in
+            + (None, None) + ks_in,
+            out_shardings=(buf_sh, None))
+        deposit_fn = jax.jit(
+            deposit_core, donate_argnums=0,
+            in_shardings=(buf_sh, buf_sh, ids_sh),
+            out_shardings=buf_sh)
+        apply_fn = jax.jit(
+            apply_core, donate_argnums=0,
+            in_shardings=(state_buf_sh, None, None),
+            out_shardings=(state_buf_sh,) + wb_out + (None,))
+        lockstep_fn = jax.jit(
+            lockstep_pub, donate_argnums=0,
+            in_shardings=(state_sh,) + rows_in + batch_in
+            + (None, None) + ks_in,
+            out_shardings=(state_sh,) + wb_out + (None,))
+    cohort_fn.raw = cohort_pub
     deposit_fn.raw = deposit_core
-    apply_fn = jax.jit(apply_core, donate_argnums=0)
     apply_fn.raw = apply_core
-    lockstep_fn = jax.jit(lockstep_core, donate_argnums=0)
-    lockstep_fn.raw = lockstep_core
+    lockstep_fn.raw = lockstep_pub
     return cohort_fn, deposit_fn, apply_fn, lockstep_fn
 
 
@@ -473,7 +636,12 @@ class BufferedFedLearner(FedLearner):
 
     Determinism: fates are pure functions of (seed, cohort, client) and
     deposits happen in heap order with a monotone tiebreak, so the same
-    seed replays the same buffer schedule bit-for-bit.
+    seed replays the same buffer schedule bit-for-bit — and because none
+    of (heap order, fate draws, take-masks) depends on the device count,
+    the schedule is the SAME on a mesh: sharding the cohort compute and
+    the buffer slots over the 'clients' axis changes where slot rows
+    live, never which slot an arrival lands in. The event cursor
+    therefore stays SIGKILL-resumable at any dp (tests/test_preemption).
     """
 
     def __init__(self, module, cfg: FedConfig, loss_train,
@@ -482,16 +650,11 @@ class BufferedFedLearner(FedLearner):
                  lr_scale_vec=None, param_specs=None,
                  fault_model: Optional[FaultModel] = None,
                  dispatch_interval: Optional[float] = None):
-        if mesh is not None:
-            raise ValueError(
-                "server_mode='buffered' runs its event loop single-chip "
-                "(shared with the online serving loop, not a sharded "
-                "throughput path); drop the mesh or use sync mode")
         if cfg.server_mode != "buffered":
             raise ValueError("BufferedFedLearner needs cfg.server_mode="
                              f"'buffered', got {cfg.server_mode!r}")
         super().__init__(module, cfg, loss_train, loss_val, rng,
-                         sample_input, lr_schedule=lr_schedule, mesh=None,
+                         sample_input, lr_schedule=lr_schedule, mesh=mesh,
                          init_params=init_params,
                          trainable_mask=trainable_mask,
                          lr_scale_vec=lr_scale_vec,
@@ -500,7 +663,17 @@ class BufferedFedLearner(FedLearner):
         (self._cohort, self._deposit, self._apply,
          self._lockstep) = build_buffer_programs(
             self._loss_train, self._round_unflatten, self.cfg,
-            trainable_mask=self._trainable_mask)
+            trainable_mask=self._trainable_mask, mesh=mesh)
+        if mesh is not None:
+            from commefficient_tpu.parallel.mesh import (
+                batch_shardings, buffer_state_shardings)
+            self._buf_sh = buffer_state_shardings(self.cfg, mesh)
+            self._take_sh = batch_shardings(mesh)[0]
+        else:
+            self._buf_sh = self._take_sh = None
+        # the apply program marks dropped writeback slots with the OOB
+        # client-count sentinel; host-side masking needs the same count
+        self._sentinel_clients = int(self.state.client_last_round.shape[0])
         self.fault_model = fault_model
         self.dispatch_interval = float(
             dispatch_interval if dispatch_interval is not None
@@ -519,10 +692,26 @@ class BufferedFedLearner(FedLearner):
 
     # -- event loop ------------------------------------------------------
 
+    def _push_writeback(self, wb):
+        """Deferred host-arena writeback (offload only): the apply hands
+        back (ids (M,), encoded rows); dropped/quarantined slots carry
+        the OOB client-count sentinel id, masked out here. Routing each
+        id to its owning shard's arena is the pipeline's job."""
+        ids, rows = wb
+        ids_np = np.asarray(jax.device_get(ids)).astype(np.int64)
+        self._offload_pipe.push(ids_np, ids_np < self._sentinel_clients,
+                                rows)
+
     def _do_apply(self, t: float) -> dict:
         with _dispatch_guard():
-            self.state, am = self._apply(self.state, self._last_lr_in,
-                                         self._apply_rng)
+            if self._offload:
+                self.state, wb, am = self._apply(
+                    self.state, self._last_lr_in, self._apply_rng)
+            else:
+                self.state, am = self._apply(self.state, self._last_lr_in,
+                                             self._apply_rng)
+        if self._offload:
+            self._push_writeback(wb)
         self._buf_count = 0
         self.applies_done += 1
         self.fault_stats["applies"] += 1
@@ -546,9 +735,13 @@ class BufferedFedLearner(FedLearner):
             chunk = workers[i:i + space]
             take = np.zeros(W, bool)
             take[chunk] = True
+            # explicit placement BEFORE the guarded dispatch (mesh: the
+            # take mask shards over 'clients' like the cohort ids)
+            take_dev = (jnp.asarray(take) if self.mesh is None
+                        else jax.device_put(take, self._take_sh))
             with _dispatch_guard():
                 new_buf = self._deposit(self.state.buffer, contrib,
-                                        jnp.asarray(take))
+                                        take_dev)
             self.state = self.state.replace(buffer=new_buf)
             self._buf_count = int(new_buf.count)
             i += len(chunk)
@@ -571,8 +764,14 @@ class BufferedFedLearner(FedLearner):
 
     def _ensure_buffer(self, contrib: BufferState):
         if self.state.buffer is None:
-            self.state = self.state.replace(buffer=init_buffer(
-                contrib, self.M, self.cfg.num_clients))
+            buf = init_buffer(contrib, self.M, self.cfg.num_clients)
+            if self.mesh is not None:
+                # committed slot-sharded placement up front: the deposit
+                # donates the buffer, so every later buffer already sits
+                # in this layout — placing the first one identically
+                # keeps the deposit/apply compile caches at one entry
+                buf = jax.device_put(buf, self._buf_sh)
+            self.state = self.state.replace(buffer=buf)
 
     # -- FedLearner surface ----------------------------------------------
 
@@ -590,12 +789,32 @@ class BufferedFedLearner(FedLearner):
         ids = jnp.asarray(client_ids, jnp.int32)
         cols = tuple(jnp.asarray(t) for t in batch)
         m = jnp.asarray(mask, jnp.float32)
+        if self.mesh is not None:
+            ids_sh, cols_sh, mask_sh = self._batch_sh
+            ids = jax.device_put(ids, ids_sh)
+            cols = jax.device_put(cols, cols_sh)
+            m = jax.device_put(m, mask_sh)
         lr_in = (jnp.float32(lr) if self.lr_scale_vec is None
                  else lr * self.lr_scale_vec)
+        if self.mesh is not None:
+            lr_in, cohort_rng = self._replicate(lr_in, cohort_rng)
         # applies triggered from here on use this cohort's rng/lr — in
         # lock-step mode that reproduces the sync round's noise chain
         self._last_lr_in = lr_in
         self._apply_rng = cohort_rng
+        ks = ((self._client_ks(client_ids),) if self.cfg.client_k_active
+              else ())
+
+        def _gather_rows_arg():
+            # host-gathered encoded rows, routed from each id's owning
+            # shard arena — the sync offload round's wire contract; the
+            # writeback is DEFERRED to whichever apply consumes the
+            # slots. Must run AFTER any drain whose applies this cohort
+            # should observe: an apply pushes fresher rows.
+            if not self._offload:
+                return ()
+            return (self._offload_pipe.gather(
+                np.asarray(client_ids).astype(np.int64)),)
 
         fm = self.fault_model
         self.fault_stats["dispatched"] += 1
@@ -606,9 +825,15 @@ class BufferedFedLearner(FedLearner):
             # the sync round; state.buffer stays None. Cross-cohort buffer
             # accumulation requires a fault model (a zero-fault FaultModel
             # works: every client arrives after one latency unit).
+            rows_arg = _gather_rows_arg()
             with _dispatch_guard():
-                self.state, raw = self._lockstep(self.state, ids, cols, m,
-                                                 lr_in, cohort_rng)
+                out = self._lockstep(self.state, *rows_arg, ids, cols, m,
+                                     lr_in, cohort_rng, *ks)
+            if self._offload:
+                self.state, wb, raw = out
+                self._push_writeback(wb)
+            else:
+                self.state, raw = out
             raw = dict(raw)
             self.applies_done += 1
             self.fault_stats["applies"] += 1
@@ -618,9 +843,15 @@ class BufferedFedLearner(FedLearner):
             # (their applies advance weights_version — the staleness this
             # cohort will eventually be judged against)
             am = self._drain(d_k)
+            rows_arg = _gather_rows_arg()
+            # buffer stripped from the cohort's input: the cohort never
+            # reads it and is not donated, and ONE pytree structure
+            # (buffer=None, first dispatch and every later one) keeps its
+            # compile cache at a single entry
             with _dispatch_guard():
-                contrib, cmetrics = self._cohort(self.state, ids, cols, m,
-                                                 lr_in, cohort_rng)
+                contrib, cmetrics = self._cohort(
+                    self.state.replace(buffer=None), *rows_arg, ids,
+                    cols, m, lr_in, cohort_rng, *ks)
             self._ensure_buffer(contrib)
             valid_np = np.asarray(mask).any(axis=1)
             started, arrives, latency = fm.cohort_fates(
@@ -646,6 +877,9 @@ class BufferedFedLearner(FedLearner):
             else:
                 raw.update(am)
 
+        if self._offload and next_client_ids is not None:
+            self._offload_pipe.prefetch(
+                np.asarray(next_client_ids).astype(np.int64))
         self.cohorts_done += 1
         self.rounds_done += 1
         raw["lr"] = lr
@@ -708,6 +942,9 @@ class BufferedFedLearner(FedLearner):
         if apply_partial and self._buf_count > 0:
             self.fault_stats["partial_applies"] += 1
             am = _merge_apply(am, self._do_apply(self.sim_time))
+        # offloaded rows: make the host arenas current too (pending
+        # writebacks from the drained applies land now)
+        self.flush_offload()
         if am is None:
             return None
         out = jax.device_get(am)

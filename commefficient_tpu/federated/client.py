@@ -190,8 +190,15 @@ def compute_gradient(apply_loss, unflatten, forward_weights, batch, mask,
 def client_step(apply_loss, unflatten, ps_weights, batch, mask, velocity,
                 error, stale_weights, rng, cfg: FedConfig,
                 sketch: Optional[CountSketch],
-                trainable_mask=None) -> ClientStepOut:
-    """One non-fedavg client's local step (ref local_step fed_worker.py:184-230)."""
+                trainable_mask=None, client_k=None) -> ClientStepOut:
+    """One non-fedavg client's local step (ref local_step fed_worker.py:184-230).
+
+    ``client_k`` (traced scalar, only under cfg.client_k_dist) is this
+    client's own transmit budget k_i <= cfg.k: the provisioned top-k
+    selection is masked down to the k_i largest-magnitude survivors
+    (federated dropout-style partial participation). Coordinates masked
+    out by the budget keep their error-feedback mass — they are simply
+    not transmitted this round."""
     if cfg.do_topk_down:
         forward_weights = reconstruct_worker_weights(
             ps_weights, stale_weights, cfg)
@@ -223,6 +230,15 @@ def client_step(apply_loss, unflatten, ps_weights, batch, mask, velocity,
     if cfg.mode == "local_topk":
         to_transmit = topk(to_transmit, cfg.k,
                            cfg.topk_approx_recall or None)
+        if client_k is not None:
+            # per-client budget: rank the provisioned selection by
+            # magnitude and keep only the client_k largest. Slots that
+            # point at zero coordinates (selection narrower than cfg.k)
+            # are harmless: where() writes 0.0 over 0.0.
+            _, sel = jax.lax.top_k(jnp.abs(to_transmit), cfg.k)
+            keep = jnp.zeros(to_transmit.shape, bool).at[sel].set(
+                jnp.arange(cfg.k) < client_k)
+            to_transmit = jnp.where(keep, to_transmit, 0.0)
         support = to_transmit != 0
         if cfg.error_type == "local":
             error = jnp.where(support, 0.0, error)   # error feedback

@@ -39,7 +39,12 @@ module closes the gap along two composable axes:
   ``[s*rows_per_shard, (s+1)*rows_per_shard)``, matching jax's
   leading-dim block sharding, so each host's arena holds exactly the
   rows its devices consume and the offload pipeline routes every
-  sampled id to its owning shard (``HostArenaStore.owner``).
+  sampled id to its owning shard (``HostArenaStore.owner``).  Buffered
+  cohorts (``server_mode='buffered'``) compose with both placements:
+  the cohort gathers rows after the pipeline drains, defers writeback
+  to apply time, and ``flush_faults`` drains the offload queue so a
+  checkpoint sees the arenas settled (docs/SCALING.md, "Owner routing
+  into buffered cohorts").
 
 Peak state memory for a W-worker round over n clients is
 ``O(n * row_bytes(codec) + W * d)``: only the sampled rows ever exist

@@ -43,6 +43,78 @@ import numpy as np
 # never shifts an existing one (replay stability across code versions)
 _TAG_STRAGGLER = 1
 _TAG_FATE = 2
+_TAG_K = 3
+
+
+def _keyed_gen(seed: int, tag: int, round_idx: int, client: int):
+    """Order-independent keyed Philox stream shared by FaultModel and the
+    client-capacity draw: the counter IS the (round, client, tag)
+    coordinates, so a draw is a pure function of its key — independent of
+    host iteration order, and bitwise replayable across a resume."""
+    bg = np.random.Philox(
+        counter=[0, int(round_idx), int(client), int(tag)],
+        key=[int(seed) & 0xFFFFFFFFFFFFFFFF, 0])
+    return np.random.Generator(bg)
+
+
+def parse_k_dist(spec: str):
+    """Parse a ``--client_k_dist`` spec into ``(lo, hi)`` k-fractions.
+
+    Format: ``uniform:lo,hi`` with ``0 < lo <= hi <= 1`` — each client's
+    budget k_i is an i.i.d.-per-client Uniform[lo, hi] fraction of the
+    provisioned cfg.k (federated dropout-style partial participation:
+    the device keeps the provisioned top-k selection and masks it down
+    to the client's own budget; masked coordinates stay in the
+    error-feedback row). Raises ValueError on a malformed spec."""
+    try:
+        kind, _, rest = spec.partition(":")
+        if kind != "uniform":
+            raise ValueError(f"unknown client_k_dist family {kind!r} "
+                             f"(supported: 'uniform')")
+        lo_s, hi_s = rest.split(",")
+        lo, hi = float(lo_s), float(hi_s)
+    except ValueError as e:
+        if "client_k_dist" in str(e):
+            raise
+        raise ValueError(
+            f"client_k_dist must look like 'uniform:lo,hi' (fractions of "
+            f"k), got {spec!r}") from None
+    if not (0.0 < lo <= hi <= 1.0):
+        raise ValueError(f"client_k_dist fractions need 0 < lo <= hi <= 1, "
+                         f"got lo={lo}, hi={hi}")
+    return lo, hi
+
+
+def client_k_for(seed: int, client: int, k: int, spec: str) -> int:
+    """One client's transmit budget k_i under ``--client_k_dist``.
+
+    A CHRONIC per-client property of the seed (round_idx pinned to 0,
+    like the straggler draw): the same client has the same capacity every
+    round, resumable and order-independent by construction. Keyed on the
+    ``_TAG_K`` Philox stream so it never shifts the fate/straggler
+    draws."""
+    lo, hi = parse_k_dist(spec)
+    u = _keyed_gen(seed, _TAG_K, 0, client).random()
+    return max(1, int(round((lo + (hi - lo) * u) * k)))
+
+
+def cohort_client_ks(seed: int, ids, k: int, spec: str,
+                     memo: dict = None) -> np.ndarray:
+    """Per-client budgets for one sampled cohort — (W,) int32, O(W) draws
+    (memoized when a cache dict is supplied, mirroring the lazy
+    straggler memo)."""
+    ids = np.asarray(ids)
+    out = np.empty(ids.shape[0], np.int32)
+    for w, cid in enumerate(ids):
+        c = int(cid)
+        if memo is not None and c in memo:
+            out[w] = memo[c]
+            continue
+        ki = client_k_for(seed, c, k, spec)
+        if memo is not None:
+            memo[c] = ki
+        out[w] = ki
+    return out
 
 
 @dataclass(frozen=True)

@@ -154,6 +154,13 @@ class BufferState:
     apply time — exactly where the sync round scatters them, which is
     what makes the lock-step buffered trajectory bit-identical to sync
     (tests/test_buffered.py).
+
+    On a mesh every leading dim here (W for the cohort output, M for the
+    server buffer) is block-sharded over the ``clients`` axis — the slot
+    buffer is a distributed object, never a replicated ``(M, d)`` aval
+    (``parallel/mesh.py:buffer_state_shardings``; the ``buffered_mesh``
+    graft-audit target fails the build if a replicated buffer sneaks
+    back in).
     """
     transmit: jax.Array         # (M, *transmit_shape)
     loss_sum: jax.Array         # (M,)

@@ -365,7 +365,9 @@ def run_online(args, mesh=None, log: bool = True,
     ``target_swaps`` hot swaps have landed, evaluating held-out
     per-user perplexity at every swap boundary and checkpointing there
     when ``--checkpoint_every_rounds`` is active. Single-chip by
-    construction (the buffered event loop's contract).
+    construction: the buffered learner itself is mesh-native now, but
+    this loop time-slices training with the decode server on one
+    host/chip, so it pins mesh=None.
     """
     if mesh is not None:
         raise ValueError(

@@ -129,9 +129,51 @@ def fed_state_shardings(cfg: FedConfig, mesh: Mesh, axis: str = "clients"):
         aborted=rep,
         weights_version=rep,
         quarantine=_ns(mesh, axis),
-        # server_mode='buffered' is single-chip (federated/buffer.py
-        # raises on a mesh), so the buffer subtree is always None here
+        # buffer=None even for server_mode='buffered': the buffer subtree
+        # only exists between the first cohort and the reset-on-apply, so
+        # the canonical state tree (what shard_state / checkpoints / the
+        # sync round see) stays buffer-less. Programs that carry a live
+        # buffer extend this tree with buffer_state_shardings below.
         buffer=None,
+    )
+
+
+def buffer_state_shardings(cfg: FedConfig, mesh: Mesh,
+                           axis: str = "clients"):
+    """Sharding pytree matching a live BufferState (federated/state.py) —
+    used both for the M-slot server buffer and the W-slot cohort
+    contribution (NamedSharding is size-agnostic; only the leading slot
+    dim's axis assignment matters).
+
+    Every slot-leading leaf shards its slot dim over the ``clients`` axis:
+    each shard owns its slot rows, so no ``(M, d)`` or ``(W, d)`` aval is
+    ever replicated (the buffered_mesh graft-audit target enforces this).
+    Dense client rows and dense transmits additionally shard their
+    coordinate dim over a ``model`` axis when present, matching the
+    fed_state_shardings row layout; sketch-mode (M, r, c) transmits shard
+    the slot dim only (tables are small). The scalar fill count is
+    replicated — every shard needs it for the slot-assignment cumsum."""
+    from commefficient_tpu.federated.state import BufferState
+    m = "model" if "model" in mesh.axis_names else None
+    slot = _ns(mesh, axis)
+    if cfg.mode == "sketch":
+        transmit = _ns(mesh, axis, None, None)
+    else:
+        transmit = _ns(mesh, axis, m) if m else slot
+    row = _ns(mesh, axis, m) if m else slot
+    return BufferState(
+        transmit=transmit,
+        loss_sum=slot,
+        metric_sums=slot,
+        num_datapoints=slot,
+        download_floats=slot,
+        cid=slot,
+        start_version=slot,
+        valid=slot,
+        count=_ns(mesh),
+        velocities=row if cfg.needs_velocity_state else None,
+        errors=row if cfg.needs_error_state else None,
+        weights=row if cfg.needs_client_weights else None,
     )
 
 

@@ -338,6 +338,20 @@ def build_parser(default_lr: float = 0.4) -> argparse.ArgumentParser:
     p.add_argument("--dispatch_interval", type=float, default=None,
                    help="simulated time between cohort dispatches "
                         "(buffered server); None = base_latency")
+    p.add_argument("--client_k_dist", type=str, default="",
+                   help="heterogeneous per-client transmit budgets for "
+                        "mode=local_topk, as 'uniform:lo,hi' fractions of "
+                        "--k (federated-dropout-style partial "
+                        "participation): each client i gets a CHRONIC "
+                        "budget k_i = round(U_i * k), U_i ~ Uniform[lo, "
+                        "hi] keyed on (--seed, i) via the fault model's "
+                        "Philox scheme — order-independent and resumable. "
+                        "The device keeps the provisioned top-k selection "
+                        "and masks it down to k_i largest-magnitude "
+                        "coordinates; masked coordinates stay in the "
+                        "error-feedback row. Byte accounting still "
+                        "charges the provisioned k (the wire format is "
+                        "provisioned). Empty = homogeneous k")
     # DP
     p.add_argument("--dp", action="store_true", dest="do_dp")
     p.add_argument("--dp_mode", choices=DP_MODES, default="worker")

@@ -289,7 +289,9 @@ def train(args, mesh=None, max_rounds=None, log=True):
                   "params inside the federated round")
 
     # --server_mode buffered swaps in the FedBuff event-loop learner
-    # (federated/buffer.py; single-chip — it rejects a mesh itself)
+    # (federated/buffer.py; mesh-native — under --mesh clients=N its
+    # programs shard like the sync round, with the slot buffer
+    # partitioned over the axis)
     from commefficient_tpu.training.args import learner_factory
     learner_cls, learner_extra = learner_factory(args, cfg.num_clients)
     if learner_cls is not FedLearner and (getattr(args, "scan_rounds", 1)

@@ -416,8 +416,18 @@ def load_checkpoint(fn: str, learner, expect_fingerprint: dict = None):
                 f"config — resuming would silently change the trajectory. "
                 f"Mismatched: {detail}")
     # ---- all validation passed; mutate ---------------------------------
+    def _place(cur, new):
+        # commit each restored leaf with the CURRENT leaf's sharding: a
+        # mesh learner's jitted programs pin in_shardings, and a plain
+        # jnp.asarray would land on device 0 and force an implicit
+        # reshard at the next dispatch — inside the transfer guard
+        if new is cur:
+            return cur
+        if isinstance(cur, jax.Array):
+            return jax.device_put(np.asarray(new), cur.sharding)
+        return jax.numpy.asarray(new)
     learner.state = jax.tree_util.tree_unflatten(
-        treedef, [jax.numpy.asarray(x) for x in restored])
+        treedef, [_place(c, x) for c, x in zip(flat, restored)])
     for lst, leaves in host_pending:
         for i in range(len(lst)):
             row = (leaves[None][i] if None in leaves

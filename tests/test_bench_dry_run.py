@@ -34,7 +34,7 @@ def test_registry_covers_every_row():
     a row cannot exist in one mode and be silently skipped by the
     other."""
     names = [n for n, _ in bench._bench_rows()]
-    assert len(names) == len(set(names)) == 33
+    assert len(names) == len(set(names)) == 34
     for must in ("cifar10_resnet9_fed_rounds_per_sec",
                  "cifar10_resnet9_per_worker_sketch_ab",
                  "gpt2_fetchsgd_per_worker_sketch_ab",

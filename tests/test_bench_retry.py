@@ -168,6 +168,13 @@ def test_main_emits_json_and_exits_zero_despite_failed_metrics(
                       "faulted_sim_time": 12.0,
                       "faulted_applies_per_cohort": 0.9})
     monkeypatch.setattr(
+        bench, "bench_buffered_mesh_rounds",
+        lambda **kw: (1.01, {"round_lockstep_single_ms": 52.0,
+                             "round_lockstep_dp2_ms": 52.5,
+                             "cohort_faulted_hetk_dp2_ms": 61.0,
+                             "event_loop_overhead_ms": 8.5,
+                             "faulted_sim_time": 12.0}))
+    monkeypatch.setattr(
         bench, "bench_decode_paged_ab",
         lambda **kw: (1.02, {"paged_tokens_per_sec_b64": 50_000.0,
                              "fixed_tokens_per_sec_b64": 49_000.0,
@@ -248,6 +255,7 @@ def test_main_emits_json_and_exits_zero_despite_failed_metrics(
     assert "cifar10_resnet9_per_worker_sketch_ab" in metrics
     assert "gpt2_fetchsgd_per_worker_sketch_ab" in metrics
     assert "client_store_sketched_codec" in metrics
+    assert "buffered_mesh_round_overhead_ab" in metrics
     assert "gpt2_decode_paged_tokens_per_sec_ab" in metrics
     assert "gpt2_decode_paged_quant_ab" in metrics
     assert "gpt2_decode_speculative_tokens_per_sec_ab" in metrics

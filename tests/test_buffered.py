@@ -147,7 +147,8 @@ def test_lockstep_matches_sync_bitwise_with_quarantine():
     assert np.isfinite(np.asarray(ln_b.state.weights)).all()
 
 
-def test_buffered_rejects_mesh_and_wrong_mode():
+def test_buffered_rejects_wrong_mode_and_indivisible_mesh():
+    from commefficient_tpu.parallel import make_mesh
     model = TinyMLP(num_classes=2, hidden=4)
     cfg = FedConfig(weight_decay=0, num_workers=W, num_clients=N_CLIENTS,
                     lr_scale=0.05, server_mode="sync", **CFG)
@@ -155,12 +156,30 @@ def test_buffered_rejects_mesh_and_wrong_mode():
         BufferedFedLearner(model, cfg, make_cv_loss(model), None,
                            jax.random.PRNGKey(1),
                            np.zeros((1, 8), np.float32))
+    # mesh itself is SUPPORTED (tests/test_buffered_mesh.py); what the
+    # mesh build rejects is a slot count that can't shard evenly — the
+    # M-slot buffer splits its slot rows over the 'clients' axis
+    cfg2 = FedConfig(weight_decay=0, num_workers=W, num_clients=N_CLIENTS,
+                     lr_scale=0.05, server_mode="buffered", buffer_m=3,
+                     **CFG)
+    with pytest.raises(ValueError, match="buffer_m.*divisible"):
+        BufferedFedLearner(model, cfg2, make_cv_loss(model), None,
+                           jax.random.PRNGKey(1),
+                           np.zeros((1, 8), np.float32),
+                           mesh=make_mesh(2))
 
 
-def test_buffered_incompatible_with_offload():
-    with pytest.raises(ValueError, match="client_state_offload"):
+def test_buffered_offload_supported_and_validated():
+    # buffered + client_state_offload is a supported combination since
+    # the mesh-native buffer refactor (deferred arena writeback at apply
+    # time; tests/test_buffered_mesh.py pins the trajectory); validate()
+    # must accept it, and the genuinely-unsupported combos still raise
+    FedConfig(num_workers=W, num_clients=N_CLIENTS,
+              server_mode="buffered", client_state_offload=True,
+              **CFG).validate()
+    with pytest.raises(ValueError, match="grad_buckets"):
         FedConfig(num_workers=W, num_clients=N_CLIENTS,
-                  server_mode="buffered", client_state_offload=True,
+                  server_mode="buffered", grad_buckets=2,
                   **CFG).validate()
 
 

@@ -1,0 +1,283 @@
+"""Mesh-native buffered aggregation (federated/buffer.py with mesh=).
+
+The load-bearing claims, each pinned here:
+
+* **Lock-step degeneracy at dp=2**: fault-free, alpha=0, the fused
+  buffered lockstep program on a 2-device 'clients' mesh is the sync
+  mesh round — BITWISE, through padded epoch tails and a NaN-guard
+  abort (the single-chip discipline of tests/test_buffered.py, now on
+  sharded state). Heterogeneous per-client k (--client_k_dist) rides
+  the same contract.
+* **Device-count independence**: the host event loop's schedule (heap
+  order, fate draws, take-masks, sim_time) is a pure function of the
+  seed — a faulted run on the mesh replays the single-chip schedule
+  exactly; only the slot rows' physical placement differs.
+* **Offload composition**: buffered + client_state_offload feeds
+  cohorts from the per-shard host arenas and writes rows back at apply
+  time (deferred writeback); the trajectory matches device-resident
+  buffered state, and the fault-free offload lockstep matches the sync
+  offload round bitwise (same program family).
+* **Sharded slots**: the buffered_mesh graft-audit target passes at
+  HEAD — every slot-leading buffer aval pinned slot-sharded, compile
+  caches at one entry — and FAILS on the replicated-buffer mutation.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from commefficient_tpu.config import FedConfig
+from commefficient_tpu.federated.api import FedLearner
+from commefficient_tpu.federated.buffer import BufferedFedLearner
+from commefficient_tpu.federated.faults import FaultModel
+from commefficient_tpu.federated.losses import make_cv_loss
+from commefficient_tpu.models import TinyMLP
+from commefficient_tpu.parallel import make_mesh
+
+N_CLIENTS = 6
+W = 2
+
+CFG = dict(mode="local_topk", error_type="local", local_momentum=0.9, k=3)
+
+
+def make_learner(server_mode="sync", mesh=None, fault_model=None, **cfg_kw):
+    kw = dict(CFG)
+    kw.update(cfg_kw)
+    model = TinyMLP(num_classes=2, hidden=4)
+    cfg = FedConfig(weight_decay=0, num_workers=W, num_clients=N_CLIENTS,
+                    lr_scale=0.05, server_mode=server_mode, **kw)
+    loss = make_cv_loss(model)
+    if server_mode == "buffered":
+        return BufferedFedLearner(model, cfg, loss, None,
+                                  jax.random.PRNGKey(1),
+                                  np.zeros((1, 8), np.float32), mesh=mesh,
+                                  fault_model=fault_model)
+    return FedLearner(model, cfg, loss, None, jax.random.PRNGKey(1),
+                      np.zeros((1, 8), np.float32), mesh=mesh)
+
+
+def scenario(seed=0, nan_round=4, n_rounds=8):
+    """Same hazard mix as tests/test_buffered.py: shared clients across
+    consecutive rounds, a padded epoch-tail slot at round 2, a NaN batch
+    at ``nan_round`` on worker 0."""
+    rng = np.random.RandomState(seed)
+    rounds = []
+    for r in range(n_rounds):
+        ids = np.array([r % N_CLIENTS, (r + 1) % N_CLIENTS])
+        Xb = rng.randn(W, 4, 8).astype(np.float32)
+        yb = rng.randint(0, 2, (W, 4)).astype(np.int32)
+        mask = np.ones((W, 4), np.float32)
+        if r == 2:
+            mask = mask.copy()
+            mask[-1] = 0.0
+        if r == nan_round:
+            Xb[0, 0, 0] = np.nan
+        rounds.append((ids, (Xb, yb), mask))
+    return rounds
+
+
+def run_buffered(ln, rounds):
+    return [ln.finalize_round_metrics(ln.train_round_async(ids, b, m))
+            for ids, b, m in rounds]
+
+
+def run_sync(ln, rounds):
+    return [ln.train_round(ids, b, m) for ids, b, m in rounds]
+
+
+def assert_bitwise_state(ln_a, ln_b):
+    for field in ("weights", "last_changed", "client_last_round",
+                  "quarantine"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ln_a.state, field)),
+            np.asarray(getattr(ln_b.state, field)), err_msg=field)
+    np.testing.assert_array_equal(np.asarray(ln_a.state.opt.Vvelocity),
+                                  np.asarray(ln_b.state.opt.Vvelocity))
+    assert int(ln_a.state.round_idx) == int(ln_b.state.round_idx)
+
+
+# ---------------------------------------------------------------------------
+# lock-step degeneracy on the mesh: buffered(dp=2) == sync(dp=2), bitwise
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("cfg_kw", [{}, dict(client_k_dist="uniform:0.3,1.0")])
+def test_lockstep_mesh_matches_sync_mesh_bitwise(cfg_kw):
+    assert len(jax.devices()) >= 2
+    mesh = make_mesh(2)
+    ln_s = make_learner("sync", mesh=mesh, **cfg_kw)
+    ln_b = make_learner("buffered", mesh=mesh, **cfg_kw)
+    rounds = scenario()
+    outs_s = run_sync(ln_s, rounds)
+    outs_b = run_buffered(ln_b, rounds)
+    # the NaN guard really latched mid-sequence — the equivalence is not
+    # vacuous — and both sides agree round by round, bitwise
+    assert outs_s[4]["aborted"] and outs_s[-1]["aborted"]
+    assert not outs_s[3]["aborted"]
+    for r, (a, b) in enumerate(zip(outs_s, outs_b)):
+        np.testing.assert_array_equal(a["loss"], b["loss"],
+                                      err_msg=f"round {r}")
+        assert a["download_bytes"] == b["download_bytes"], r
+        assert a["upload_bytes"] == b["upload_bytes"], r
+    assert_bitwise_state(ln_s, ln_b)
+    for field in ("velocities", "errors"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ln_s.state.clients, field)),
+            np.asarray(getattr(ln_b.state.clients, field)), err_msg=field)
+    # ONE fused program across all 8 rounds, abort branch included
+    assert ln_b._lockstep._cache_size() == 1
+
+
+def test_het_k_draws_chronic_and_trajectory_distinct():
+    from commefficient_tpu.federated.faults import (client_k_for,
+                                                    cohort_client_ks,
+                                                    parse_k_dist)
+    # chronic: a client's budget is keyed on (seed, client) only — the
+    # same k_i in every round — and bounded in [1, k]
+    ks = cohort_client_ks(21, np.arange(N_CLIENTS), 3, "uniform:0.3,1.0")
+    assert ks.shape == (N_CLIENTS,) and ks.dtype == np.int32
+    assert all(1 <= int(k) <= 3 for k in ks)
+    assert all(int(client_k_for(21, c, 3, "uniform:0.3,1.0")) == int(ks[c])
+               for c in range(N_CLIENTS))
+    assert not np.array_equal(
+        ks, cohort_client_ks(22, np.arange(N_CLIENTS), 3,
+                             "uniform:0.3,1.0"))
+    for bad in ("uniform:0,1", "uniform:0.5", "gauss:0.1,0.9",
+                "uniform:0.9,0.3"):
+        with pytest.raises(ValueError):
+            parse_k_dist(bad)
+    # a genuinely heterogeneous draw changes the trajectory vs k_i == k
+    mesh = make_mesh(2)
+    rounds = scenario(nan_round=None, n_rounds=4)
+    ln_hom = make_learner("buffered", mesh=mesh)
+    ln_het = make_learner("buffered", mesh=mesh,
+                          client_k_dist="uniform:0.3,1.0")
+    run_buffered(ln_hom, rounds)
+    run_buffered(ln_het, rounds)
+    assert not np.array_equal(np.asarray(ln_hom.state.weights),
+                              np.asarray(ln_het.state.weights))
+    # ...but byte accounting still charges the PROVISIONED k (the
+    # transmit aval is (k,)-shaped regardless of each client's draw)
+    assert ln_hom.total_upload_bytes == ln_het.total_upload_bytes
+
+
+# ---------------------------------------------------------------------------
+# the event loop's schedule is device-count-independent
+# ---------------------------------------------------------------------------
+
+def faulted(mesh, **cfg_kw):
+    fm = FaultModel(7, N_CLIENTS, straggler_frac=0.3, straggler_mult=5.0,
+                    dropout_prob=0.15, crash_prob=0.05)
+    return make_learner("buffered", mesh=mesh, fault_model=fm, buffer_m=4,
+                        staleness_alpha=0.5, **cfg_kw)
+
+
+def test_fault_schedule_device_count_independent():
+    rounds = scenario(nan_round=None, n_rounds=12)
+    ln_1 = faulted(mesh=None)
+    ln_2 = faulted(mesh=make_mesh(2))
+    outs_1 = run_buffered(ln_1, rounds)
+    outs_2 = run_buffered(ln_2, rounds)
+    ln_1.flush_faults()
+    ln_2.flush_faults()
+    # identical SCHEDULE: fates, heap order, applies, simulated clock
+    assert ln_1.fault_stats == ln_2.fault_stats
+    assert ln_1.sim_time == ln_2.sim_time
+    assert ln_1.applies_done == ln_2.applies_done > 0
+    assert ln_1.fault_stats["dropouts"] + ln_1.fault_stats["crashes"] > 0
+    # identical accounting (exact integer-valued float arithmetic)
+    assert ln_1.total_download_bytes == ln_2.total_download_bytes
+    assert ln_1.total_upload_bytes == ln_2.total_upload_bytes
+    for a, b in zip(outs_1, outs_2):
+        assert a["aborted"] == b["aborted"]
+    # the MATH matches to cross-program tolerance (mesh vs single-chip
+    # are different XLA programs — same bound as tests/test_mesh.py)
+    np.testing.assert_allclose(np.asarray(ln_2.state.weights),
+                               np.asarray(ln_1.state.weights),
+                               rtol=2e-4, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# buffered x client_state_offload (the PR 11 host arenas feed cohorts)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mesh_n", [None, 2])
+def test_buffered_offload_matches_device_resident(mesh_n):
+    mesh = None if mesh_n is None else make_mesh(mesh_n)
+    rounds = scenario(nan_round=None, n_rounds=8)
+    ln_dev = faulted(mesh=mesh)
+    ln_off = faulted(mesh=mesh, client_state_offload=True)
+    run_buffered(ln_dev, rounds)
+    run_buffered(ln_off, rounds)
+    ln_dev.flush_faults()
+    ln_off.flush_faults()
+    assert ln_dev.fault_stats == ln_off.fault_stats
+    np.testing.assert_array_equal(np.asarray(ln_dev.state.weights),
+                                  np.asarray(ln_off.state.weights))
+    # arena rows vs device rows: different XLA programs (rows-as-input
+    # vs in-state gather), so the repo's cross-program row tolerance
+    # (tests/test_client_store.py) — weights above stay bitwise
+    for field in ("velocities", "errors"):
+        dev_rows = np.asarray(getattr(ln_dev.state.clients, field))
+        off_rows = np.stack([ln_off.host_clients[field][i]
+                             for i in range(N_CLIENTS)])
+        np.testing.assert_allclose(dev_rows, off_rows, rtol=0, atol=1e-6,
+                                   err_msg=field)
+
+
+def test_lockstep_offload_matches_sync_offload_bitwise():
+    # SAME program family on both sides (offload cohort + offload apply),
+    # so the fault-free alpha=0 equivalence is bitwise — including the
+    # host arena contents after flush
+    mesh = make_mesh(2)
+    rounds = scenario(nan_round=None, n_rounds=4)
+    ln_s = make_learner("sync", mesh=mesh, client_state_offload=True)
+    ln_b = make_learner("buffered", mesh=mesh, client_state_offload=True)
+    outs_s = run_sync(ln_s, rounds)
+    outs_b = run_buffered(ln_b, rounds)
+    ln_b.flush_offload()
+    for a, b in zip(outs_s, outs_b):
+        np.testing.assert_array_equal(a["loss"], b["loss"])
+    assert_bitwise_state(ln_s, ln_b)
+    for field in ("velocities", "errors"):
+        rows_s = np.stack([ln_s.host_clients[field][i]
+                           for i in range(N_CLIENTS)])
+        rows_b = np.stack([ln_b.host_clients[field][i]
+                           for i in range(N_CLIENTS)])
+        np.testing.assert_array_equal(rows_s, rows_b, err_msg=field)
+
+
+# ---------------------------------------------------------------------------
+# graft-audit: sharded slots enforced, mutation must fail
+# ---------------------------------------------------------------------------
+
+@pytest.mark.audit
+def test_buffered_mesh_audit_passes_at_head():
+    """Every slot-leading buffer aval in the cohort->deposit->apply
+    chain is pinned slot-sharded along 'clients', nothing calls back to
+    the host, and the driven dp=2 event loop keeps all four program
+    caches at one entry."""
+    from commefficient_tpu import analysis as A
+
+    rep = A.build_targets("buffered_mesh")[0].audit(with_retrace=True)
+    assert rep.target == "buffered_mesh/chain"
+    assert rep.ok, rep.format()
+    sb = rep.rule("sharded_buffer")
+    assert sb.ok and "slot constraints checked" in sb.notes
+
+
+@pytest.mark.audit
+def test_buffered_mesh_audit_fails_on_replicated_buffer():
+    """Mutation: the SAME chain with every deposited buffer leaf
+    re-pinned to the replicated spec P() — the program a
+    replicated-buffer reintroduction would produce — must FAIL the
+    sharded_buffer rule. This is what makes the PASS at HEAD
+    meaningful."""
+    from commefficient_tpu.analysis.targets import buffered_mesh_target
+
+    rep = buffered_mesh_target(mutate=True).audit(with_retrace=False)
+    assert rep.target == "buffered_mesh/chain(mutated)"
+    assert not rep.ok
+    sb = rep.rule("sharded_buffer")
+    assert not sb.ok
+    msgs = " ".join(v.message for v in sb.violations)
+    assert "slots not sharded along 'clients'" in msgs

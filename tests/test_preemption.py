@@ -7,9 +7,10 @@ process, SIGKILL it at an arbitrary mid-training point (and separately
 rename, via the COMMEFF_CRASH_POINT hook), restart with ``--resume
 auto``, and assert the final exported state is **bitwise identical**
 (`assert_array_equal`) to a never-killed run — for the sync server (with
-and without ``--client_state_offload``) and the buffered server.
-(buffered + offload is rejected at config level: contribution slots
-already buffer the sampled rows.)
+and without ``--client_state_offload``) and the buffered server, both
+single-chip and on a dp=2 'clients' mesh with host-offloaded client
+state and heterogeneous per-client k (the buffered event cursor is
+device-count-independent, so the resume contract holds at any dp).
 
 The in-process tests cover the checkpoint-format pieces in isolation:
 corrupt-file fallback, digest rejection, retention, fingerprint
@@ -60,6 +61,24 @@ _CONFIGS = {
     "sync_sketched": ["--mode", "local_topk", "--error_type", "local",
                       "--k", "5", "--client_state", "sketched",
                       "--client_sketch_cols", "32"],
+    # the mesh-native buffered server, composed with everything it
+    # composes with: dp=2 sharded slot rows, host-arena client state
+    # (deferred writeback at apply), and a heterogeneous per-client k
+    # drawn from the chronic (seed, client) Philox key — kill/restart
+    # must stay bitwise because none of the event cursor, the k draws,
+    # or the heap schedule depends on the device count
+    "buffered_mesh": ["--mode", "local_topk", "--error_type", "local",
+                      "--k", "5", "--server_mode", "buffered",
+                      "--client_state_offload", "--client_k_dist",
+                      "uniform:0.5,1.0", "--mesh", "clients=2"],
+}
+
+#: per-config child environment: the mesh arm needs virtual devices
+#: (the harness strips the parent's XLA_FLAGS — children default to
+#: the real single-chip CLI environment)
+_ENVS = {
+    "buffered_mesh": {
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2"},
 }
 
 
@@ -90,10 +109,10 @@ def _run(workdir, argv, env_extra=None, timeout=240):
 
 
 def _kill_when_step_file(workdir, argv, ckpt_dir, sig=signal.SIGKILL,
-                        timeout=240):
+                        timeout=240, env_extra=None):
     """Start the CLI, wait for the first periodic step checkpoint to
     appear, then deliver ``sig`` — the arbitrary-point preemption."""
-    p = _launch(workdir, argv)
+    p = _launch(workdir, argv, env_extra)
     deadline = time.time() + timeout
     try:
         while time.time() < deadline:
@@ -136,7 +155,8 @@ def _baseline(tmp_path_factory, cfg_key):
     ckpt = os.path.join(str(d), "ckpt")
     rc, out = _run(d, _BASE + _CONFIGS[cfg_key]
                    + ["--dataset_dir", str(d / "ds"),
-                      "--checkpoint", "--checkpoint_path", ckpt])
+                      "--checkpoint", "--checkpoint_path", ckpt],
+                   env_extra=_ENVS.get(cfg_key))
     assert rc == 0, out
     return ckpt
 
@@ -151,11 +171,14 @@ def _kill_resume_roundtrip(tmp_path, cfg_key, baseline_ckpt):
     argv = _BASE + _CONFIGS[cfg_key] + [
         "--dataset_dir", str(tmp_path / "ds"), "--checkpoint",
         "--checkpoint_path", ckpt, "--checkpoint_every_rounds", "10"]
-    rc, out = _kill_when_step_file(tmp_path, argv, ckpt)
+    env_extra = _ENVS.get(cfg_key)
+    rc, out = _kill_when_step_file(tmp_path, argv, ckpt,
+                                   env_extra=env_extra)
     assert rc == -signal.SIGKILL, out
     # the kill interrupted the run: no final export yet
     assert not os.path.exists(os.path.join(ckpt, "TinyMLP.npz"))
-    rc, out = _run(tmp_path, argv + ["--resume", "auto"])
+    rc, out = _run(tmp_path, argv + ["--resume", "auto"],
+                   env_extra=env_extra)
     assert rc == 0, out
     assert "resumed from" in out, out
     _assert_final_bitwise(baseline_ckpt, ckpt)
@@ -168,7 +191,8 @@ def test_crash_resume_smoke(tmp_path, sync_baseline):
 
 
 @pytest.mark.parametrize("cfg_key", ["sync_offload", "buffered",
-                                     "sync_sparse", "sync_sketched"])
+                                     "sync_sparse", "sync_sketched",
+                                     "buffered_mesh"])
 def test_kill_resume_bitwise(tmp_path, tmp_path_factory, cfg_key):
     _kill_resume_roundtrip(tmp_path, cfg_key,
                            _baseline(tmp_path_factory, cfg_key))
