@@ -1955,6 +1955,154 @@ def bench_per_worker_sketch_ab(d=6_570_240, W=8, r=5, c=500_000):
         "bitwise_equal": bitwise, "d": d, "W": W, "r": r, "c": c}
 
 
+def bench_server_update_fused_ab(d=124_440_576, k=50_000, r=5, c=500_000):
+    """BENCH_r09 A/B: the fused server-update path (--server_fused auto,
+    ops/topk_kernels.py) vs the incumbent chain, at gpt2-small scale
+    (d=124.4M, k=50k) for BOTH modes that select server-side:
+
+    * true_topk — one streaming pass fusing momentum, error
+      accumulation, the exact radix top-k and both error-feedback
+      residuals (forced 'kernel') vs momentum -> err -> lax.top_k ->
+      scatter -> two jnp.where sweeps (forced 'fallback', the program
+      ``--server_fused off`` pins).
+    * sketch — fused unsketch+select (estimates computed per tile in
+      VMEM, the (d,) estimate vector never materialized) vs
+      estimate-all -> topk_values_indices.
+
+    Same chip, back-to-back, each arm compiled inside its own
+    force_dispatch context; updates AND new (Vvelocity, Verror) state
+    checked BITWISE-equal between arms before any ratio is reported
+    (the contract tests/test_server_fused.py pins at toy scale).
+    Refutation is budgeted: a ratio below 1 is recorded as the measured
+    answer, not suppressed — adjudication in docs/ROOFLINE.md Round 9.
+
+    Dry-run: traces both arms' programs on CPU and asserts the kernel
+    arm's jaxpr contains pallas_call while the fallback arm's does not,
+    so a dispatch regression fails CI's trace, not just the on-chip
+    capture."""
+    import jax
+    import jax.numpy as jnp
+
+    from commefficient_tpu.config import FedConfig
+    from commefficient_tpu.federated.server import (init_server_opt_state,
+                                                    make_sketch,
+                                                    server_update)
+    from commefficient_tpu.ops import sketch_kernels
+
+    cfgs = {
+        "true_topk": FedConfig(mode="true_topk", error_type="virtual",
+                               k=k, virtual_momentum=0.9).finalize(d),
+        "sketch": FedConfig(mode="sketch", error_type="virtual", k=k,
+                            num_rows=r, num_cols=c,
+                            virtual_momentum=0.9).finalize(d),
+    }
+    breakdown = {"d": d, "k": k, "r": r, "c": c}
+    ratios = {}
+    for mode, cfg in cfgs.items():
+        sketch = make_sketch(cfg) if mode == "sketch" else None
+
+        def fn(g, st, _cfg=cfg, _sk=sketch):
+            return server_update(g, st, _cfg, 0.1, sketch=_sk)
+
+        if DRY_RUN:
+            g_shape = ((sketch.r, sketch.c_eff) if mode == "sketch"
+                       else (cfg.grad_dim,))
+            g = jax.ShapeDtypeStruct(g_shape, jnp.float32)
+            st = jax.eval_shape(lambda _cfg=cfg: init_server_opt_state(_cfg))
+            for force, want_kernel in (("kernel", True),
+                                       ("fallback", False)):
+                with sketch_kernels.force_dispatch(force):
+                    has = "pallas_call" in str(jax.make_jaxpr(fn)(g, st))
+                    assert has == want_kernel, (mode, force, has)
+            continue
+        if mode == "sketch":
+            vec = jax.random.normal(jax.random.PRNGKey(0),
+                                    (cfg.grad_dim,), jnp.float32)
+            g = jax.jit(sketch.sketch_vec)(vec)
+            del vec
+        else:
+            g = jax.random.normal(jax.random.PRNGKey(0),
+                                  (cfg.grad_dim,), jnp.float32)
+        ms, outs = {}, {}
+        for force in ("kernel", "fallback"):
+            with sketch_kernels.force_dispatch(force):
+                jitted = jax.jit(fn)
+                st = init_server_opt_state(cfg)
+                upd, new_st = jitted(g, st)
+                _sync(upd)
+                ms[force] = _time(jitted, g, st, n=5) * 1e3
+                outs[force] = (upd, new_st)
+        for a, b in zip(jax.tree_util.tree_leaves(outs["kernel"]),
+                        jax.tree_util.tree_leaves(outs["fallback"])):
+            assert bool(jnp.all(a == b)), \
+                f"{mode}: fused server update diverged from incumbent"
+        del outs, g
+        ratios[mode] = ms["fallback"] / ms["kernel"]
+        breakdown[f"{mode}_fused_ms"] = round(ms["kernel"], 3)
+        breakdown[f"{mode}_incumbent_ms"] = round(ms["fallback"], 3)
+        breakdown[f"{mode}_speedup_x"] = round(ratios[mode], 4)
+        breakdown[f"{mode}_bitwise_equal"] = True
+    if DRY_RUN:
+        return None, breakdown
+    return ratios["sketch"], breakdown
+
+
+def bench_topk_hierarchical_ab(d=124_440_576, ks=(5_000, 50_000, 500_000)):
+    """BENCH_r09 A/B: the streaming two-pass radix top-k kernel vs the
+    sort-unit incumbent (jax.lax.top_k via ops/topk's masking path) on a
+    dense (d,) vector at gpt2-small d, swept over k spanning two orders
+    of magnitude around the paper's operating point (k = 50k at
+    compression d/k ~ 2500x). Both arms run the PUBLIC ``topk`` entry
+    under forced dispatch, so the row measures exactly what a dispatch
+    flip changes and nothing else; masked outputs are checked
+    BITWISE-equal per k (ties, signs and all — the lowest-index
+    tie-break contract of tests/test_topk_kernels.py). Headline ratio is
+    the k=50k point; the sweep rides in the breakdown.
+
+    Dry-run: traces both arms per k on CPU, asserting pallas_call
+    presence/absence in the jaxprs."""
+    import jax
+    import jax.numpy as jnp
+
+    from commefficient_tpu.ops import sketch_kernels
+    from commefficient_tpu.ops.topk import topk
+
+    breakdown = {"d": d, "ks": list(ks)}
+    ratios = {}
+    for k in ks:
+        def fn(v, _k=k):
+            return topk(v, _k)
+
+        if DRY_RUN:
+            v = jax.ShapeDtypeStruct((d,), jnp.float32)
+            for force, want_kernel in (("kernel", True),
+                                       ("fallback", False)):
+                with sketch_kernels.force_dispatch(force):
+                    has = "pallas_call" in str(jax.make_jaxpr(fn)(v))
+                    assert has == want_kernel, (k, force, has)
+            continue
+        v = jax.random.normal(jax.random.PRNGKey(1), (d,), jnp.float32)
+        ms, outs = {}, {}
+        for force in ("kernel", "fallback"):
+            with sketch_kernels.force_dispatch(force):
+                jitted = jax.jit(fn)
+                out = jitted(v)
+                _sync(out)
+                ms[force] = _time(jitted, v, n=5) * 1e3
+                outs[force] = out
+        assert bool(jnp.all(outs["kernel"] == outs["fallback"])), \
+            f"k={k}: kernel top-k diverged from lax.top_k masking"
+        del outs
+        ratios[k] = ms["fallback"] / ms["kernel"]
+        breakdown[f"k{k}_kernel_ms"] = round(ms["kernel"], 3)
+        breakdown[f"k{k}_sort_unit_ms"] = round(ms["fallback"], 3)
+        breakdown[f"k{k}_speedup_x"] = round(ratios[k], 4)
+    if DRY_RUN:
+        return None, breakdown
+    return ratios[50_000] if 50_000 in ratios else \
+        ratios[max(ratios)], breakdown
+
+
 def bench_client_store_sketched_codec(d=6_570_240, W=8, r=3, c=128,
                                       k=50_000):
     """BENCH_r08: encode/decode cost of the sketched client-state codec
@@ -2576,6 +2724,10 @@ def _bench_rows():
         ("gpt2_fetchsgd_per_worker_sketch_ab",
          lambda: bench_per_worker_sketch_ab(d=124_440_576, W=4, r=5,
                                             c=500_000)),
+        ("gpt2_server_update_fused_ab",
+         lambda: bench_server_update_fused_ab()),
+        ("topk_hierarchical_ab",
+         lambda: bench_topk_hierarchical_ab()),
         ("client_store_sketched_codec",
          lambda: bench_client_store_sketched_codec()),
         ("buffered_fedbuff_round_overhead",
@@ -2821,6 +2973,29 @@ def main():
                         f"back-to-back, tables checked bitwise-equal; "
                         f"refutation budgeted (a ratio < 1 is the "
                         f"measured answer)"}) if pw is not None else None)
+    srv_fused_ab = res["gpt2_server_update_fused_ab"]
+    add("gpt2_server_update_fused_ab",
+        round(srv_fused_ab[0], 4) if srv_fused_ab is not None else None,
+        "speedup_x",
+        dict(srv_fused_ab[1], **{
+            "note": "BENCH_r09: fused server update (--server_fused "
+                    "auto — streaming radix top-k + unsketch/momentum/"
+                    "error-feedback epilogue) vs the incumbent chain at "
+                    "gpt2 scale, true_topk AND sketch modes, updates and "
+                    "state bitwise-checked between arms; headline is the "
+                    "sketch-mode ratio, refutation budgeted (ratio < 1 "
+                    "is the measured answer) — docs/ROOFLINE.md Round 9"})
+        if srv_fused_ab is not None else None)
+    topk_ab = res["topk_hierarchical_ab"]
+    add("topk_hierarchical_ab",
+        round(topk_ab[0], 4) if topk_ab is not None else None,
+        "speedup_x",
+        dict(topk_ab[1], **{
+            "note": "BENCH_r09: streaming two-pass radix top-k kernel vs "
+                    "jax.lax.top_k masking through the public dispatch, "
+                    "d=124.4M, k swept {5k, 50k, 500k}, outputs bitwise-"
+                    "checked per k; headline is the paper operating "
+                    "point k=50k"}) if topk_ab is not None else None)
     codec_ab = res["client_store_sketched_codec"]
     add("client_store_sketched_codec",
         round(codec_ab[0], 4) if codec_ab is not None else None,

@@ -24,6 +24,7 @@ def main(argv=None) -> int:
                     "retrace / dtype / prng)")
     parser.add_argument("--target", default="all",
                         choices=["round", "round_bucketed", "sketch_batched",
+                                 "server_update_fused",
                                  "buffered", "buffered_mesh",
                                  "client_store", "gpt2",
                                  "attention", "sketch", "decode",

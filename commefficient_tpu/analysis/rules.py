@@ -173,6 +173,101 @@ class FootprintRule:
 
 
 # --------------------------------------------------------------------------
+# fused server update (streaming top-k kernel path)
+# --------------------------------------------------------------------------
+
+
+#: Selection primitives of the incumbent sort-unit chain. ``lax.top_k``
+#: traces as ``top_k`` (lowering to ``sort``), ``jnp.argsort``-style
+#: selections as ``sort``; ``approx_top_k`` never belongs in the exact
+#: fused path either (it is the separate opt-in approx_recall mode).
+SORT_SELECT_PRIMITIVES = frozenset({"sort", "top_k", "approx_top_k"})
+
+
+class FusedServerUpdateRule:
+    """The server update runs the fused streaming top-k path, not the
+    re-materialized sort chain.
+
+    Three structural claims over the walked server-update jaxpr:
+
+    1. at least ``min_pallas`` ``pallas_call`` eqns appear (the radix
+       counting kernel inside the refinement loop + the select/epilogue
+       kernel);
+    2. NO sort-unit selection runs over the d-stream: a ``top_k`` /
+       ``sort`` / ``approx_top_k`` eqn consuming an operand whose
+       trailing dimension is d is exactly the incumbent O(d·log d)
+       stage the kernel replaces;
+    3. the program materializes at most ``max_live_d`` d-shaped eqn
+       outputs (ANY dtype — the incumbent chain's score vector, scatter
+       mask, support mask and per-stage ``where``s each add one). The
+       budget is the kernel path's own count plus zero slack, so
+       re-materializing even part of the chain FAILS (the mutation arm
+       pins the re-materialized count strictly above it).
+
+    ``d`` binds from the audit dims, like the footprint patterns.
+    """
+
+    name = "fused_server_update"
+
+    def __init__(self, max_live_d: int, min_pallas: int = 1):
+        self.max_live_d = int(max_live_d)
+        self.min_pallas = int(min_pallas)
+
+    def check(self, sites: Sequence[EqnSite], stats: WalkStats,
+              dims: dict) -> RuleReport:
+        d = int(dims["d"])
+        report = RuleReport(rule=self.name, ok=True)
+        pallas_calls = 0
+        live_d = 0
+        for site in sites:
+            report.checked_eqns += 1
+            if site.primitive == "pallas_call":
+                pallas_calls += 1
+            ins, outs = [], []
+            for kind, vs in (("in", site.eqn.invars),
+                             ("out", site.eqn.outvars)):
+                for v in vs:
+                    aval = getattr(v, "aval", None)
+                    if aval is None or not hasattr(aval, "shape"):
+                        continue
+                    (ins if kind == "in" else outs).append(
+                        (tuple(aval.shape), str(getattr(aval, "dtype",
+                                                        "?"))))
+            if site.primitive in SORT_SELECT_PRIMITIVES and any(
+                    shape and shape[-1] == d for shape, _ in ins):
+                report.ok = False
+                report.violations.append(Violation(
+                    rule=self.name, path=site.path,
+                    primitive=site.primitive,
+                    message=f"sort-unit selection over the d-stream "
+                            f"(operand trailing dim {d}) — the "
+                            f"incumbent chain the fused kernel "
+                            f"replaces"))
+            for shape, dtype in outs:
+                if shape == (d,):
+                    live_d += 1
+        if pallas_calls < self.min_pallas:
+            report.ok = False
+            report.violations.append(Violation(
+                rule=self.name, path="", primitive="pallas_call",
+                message=f"expected >= {self.min_pallas} pallas_call "
+                        f"eqns (streaming top-k kernels), saw "
+                        f"{pallas_calls}"))
+        if live_d > self.max_live_d:
+            report.ok = False
+            report.violations.append(Violation(
+                rule=self.name, path="", primitive="*",
+                shape=(d,),
+                message=f"{live_d} live ({d},)-shaped eqn outputs "
+                        f"exceed the fused-path budget "
+                        f"{self.max_live_d} — the d-vector chain is "
+                        f"re-materializing"))
+        report.notes = (f"pallas_calls seen: {pallas_calls}; live (d,) "
+                        f"outputs: {live_d} (budget {self.max_live_d})")
+        return report
+
+
+# --------------------------------------------------------------------------
 # bucketed transmit (--grad_buckets)
 # --------------------------------------------------------------------------
 

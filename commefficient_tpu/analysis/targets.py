@@ -25,9 +25,10 @@ import numpy as np
 from .report import AuditReport
 from .retrace import check_retrace
 from .rules import (DEFAULT_PATTERNS, BatchedSketchRule,
-                    BucketedTransmitRule, FootprintRule, RuleReport,
-                    ShapePattern, ShardedBufferRule, ShardedPoolRule,
-                    TransferRule, Violation)
+                    BucketedTransmitRule, FootprintRule,
+                    FusedServerUpdateRule, RuleReport, ShapePattern,
+                    ShardedBufferRule, ShardedPoolRule, TransferRule,
+                    Violation)
 from .walker import walk
 
 
@@ -292,6 +293,93 @@ def sketch_batched_target(mutate: bool = False) -> AuditTarget:
         rules=(FootprintRule(DEFAULT_PATTERNS), TransferRule(),
                BatchedSketchRule(W=w, r=cfg_kw["num_rows"],
                                  c_eff=pad_cols(cfg_kw["num_cols"]))),
+        retrace=retrace)
+
+
+# --------------------------------------------------------------------------
+# fused server update (streaming top-k kernel path, round 9)
+# --------------------------------------------------------------------------
+
+#: max_live_d budgets per mode, measured on the fused program at HEAD —
+#: zero slack, so re-materializing even one stage of the incumbent
+#: d-vector chain fails. The mutated arms' counts sit strictly above
+#: (18 and 190 vs these 13 and 20 at d=1000, k=5).
+_FUSED_SERVER_BUDGETS = {"true_topk": 13, "sketch": 20}
+
+
+def server_update_fused_target(mode: str = "true_topk",
+                               mutate: bool = False) -> AuditTarget:
+    """The server update runs the FUSED streaming top-k path.
+
+    Traces the jitted ``server_update`` alone — the program the round
+    step embeds — for the exact-mode true_topk and sketch configs, and
+    asserts via :class:`FusedServerUpdateRule` that (1) the streaming
+    radix/select ``pallas_call``s are present, (2) no sort-unit
+    selection (``top_k``/``sort``) runs over the d-stream, and (3) the
+    count of live d-shaped eqn outputs stays at the fused path's own
+    measured budget — the ISSUE-20 contract that the round writes only
+    the outputs it must keep (update / Vvelocity / Verror) and never
+    re-materializes the estimates -> scores -> sort -> mask -> where
+    chain.
+
+    Dispatch is forced with ``force_dispatch`` exactly like
+    :func:`sketch_batched_target`: "kernel" walks the real kernel
+    program on CPU (the Pallas interpreter executes it in the retrace
+    drives); ``mutate=True`` forces "fallback" — the incumbent chain a
+    dispatch revert would produce — and the audit must FAIL on it
+    (tests/test_analysis_audits.py pins all three violation classes).
+    """
+    from functools import partial
+
+    from commefficient_tpu.config import FedConfig
+    from commefficient_tpu.federated.server import (init_server_opt_state,
+                                                    make_sketch,
+                                                    server_update)
+    from commefficient_tpu.ops import sketch_kernels
+
+    if mode not in ("true_topk", "sketch"):
+        raise ValueError(f"mode must be true_topk|sketch, got {mode!r}")
+    d, k = 1_000, 5
+    cfg_kw = dict(mode=mode, k=k, error_type="virtual",
+                  virtual_momentum=0.9)
+    if mode == "sketch":
+        cfg_kw.update(num_rows=3, num_cols=256)
+    cfg = FedConfig(**cfg_kw).finalize(d)
+    sketch = make_sketch(cfg) if mode == "sketch" else None
+    state = init_server_opt_state(cfg)
+    force = "fallback" if mutate else "kernel"
+
+    def fn(g, st, lr):
+        return server_update(g, st, cfg, lr, sketch=sketch)
+
+    jitted = jax.jit(fn)
+    g_shape = cfg.transmit_shape
+
+    def trace():
+        with sketch_kernels.force_dispatch(force):
+            return jax.make_jaxpr(fn)(
+                jnp.zeros(g_shape, jnp.float32), state, jnp.float32(0.05))
+
+    def retrace():
+        rng = np.random.RandomState(17)
+
+        def make_args(i):
+            return (jnp.asarray(rng.randn(*g_shape).astype(np.float32)),
+                    state, jnp.float32(0.05))
+
+        # one context around warmup + drives (force_dispatch clears jit
+        # caches at its edges; the cache-stays-at-1 guard runs inside)
+        with sketch_kernels.force_dispatch(force):
+            return check_retrace(jitted, make_args, repeats=3, warmup=1)
+
+    return AuditTarget(
+        name=f"server_update_fused/{mode}" + ("(mutated)" if mutate else ""),
+        description=f"fused server update, mode={mode}, d={d}, k={k}, "
+                    f"forced dispatch={force}",
+        trace=trace,
+        dims={"d": d},
+        rules=(FusedServerUpdateRule(
+            max_live_d=_FUSED_SERVER_BUDGETS[mode], min_pallas=2),),
         retrace=retrace)
 
 
@@ -1419,6 +1507,9 @@ def build_targets(name: str) -> list:
                 round_bucketed_target("sketch")]
     if name == "sketch_batched":
         return [sketch_batched_target()]
+    if name == "server_update_fused":
+        return [server_update_fused_target("true_topk"),
+                server_update_fused_target("sketch")]
     if name == "decode":
         return [decode_target("step"), decode_target("generate")]
     if name == "decode_paged":
@@ -1436,6 +1527,7 @@ def build_targets(name: str) -> list:
     if name == "all":
         return (build_targets("round") + build_targets("round_bucketed")
                 + build_targets("sketch_batched")
+                + build_targets("server_update_fused")
                 + build_targets("buffered")
                 + build_targets("buffered_mesh")
                 + build_targets("client_store")
@@ -1447,7 +1539,8 @@ def build_targets(name: str) -> list:
                 + build_targets("serve_multihost")
                 + build_targets("online_loop"))
     raise ValueError(f"unknown audit target {name!r} (round|round_bucketed|"
-                     f"sketch_batched|buffered|buffered_mesh|client_store|"
+                     f"sketch_batched|server_update_fused|buffered|"
+                     f"buffered_mesh|client_store|"
                      f"gpt2|attention|sketch|decode|decode_paged|"
                      f"decode_speculative|decode_paged_quant|"
                      f"serve_multihost|online_loop|all)")

@@ -92,6 +92,16 @@ class FedConfig:
     # the error-feedback accumulators, the same mechanism that absorbs
     # sketch-recovery noise (ops/topk.py module docstring).
     topk_approx_recall: float = 0.0
+    # Fused server-update path (ops/topk_kernels.py): 'auto' lets the
+    # server's exact top-k recovery run as the streaming two-pass radix
+    # kernel + fused momentum/error-feedback epilogue wherever the
+    # kernel dispatches (TPU backends, or force_dispatch for A/B);
+    # 'off' pins the incumbent lax.top_k chain everywhere. Both paths
+    # are bitwise-identical in exact mode (tests/test_topk_kernels.py,
+    # tests/test_server_fused.py), so this is a perf/debug switch, not
+    # a semantics switch. approx_recall > 0 always takes the incumbent
+    # approx path regardless of this flag.
+    server_fused: str = "auto"
 
     # optimization. NOTE: the reference defaults local_momentum to 0.9
     # (utils.py:151) which is invalid with its own default mode='sketch'
@@ -282,6 +292,9 @@ class FedConfig:
         if not 0.0 <= self.topk_approx_recall <= 1.0:
             raise ValueError("topk_approx_recall must be in [0, 1] "
                              "(0 = exact top-k)")
+        if self.server_fused not in ("auto", "off"):
+            raise ValueError("server_fused must be 'auto' or 'off', "
+                             f"got {self.server_fused!r}")
         if self.sketch_scheme not in ("tiled", "global"):
             raise ValueError("sketch_scheme must be 'tiled' or 'global', "
                              f"got {self.sketch_scheme!r}")

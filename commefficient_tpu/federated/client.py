@@ -228,17 +228,27 @@ def client_step(apply_loss, unflatten, ps_weights, batch, mask, velocity,
         to_transmit = carrier
 
     if cfg.mode == "local_topk":
-        to_transmit = topk(to_transmit, cfg.k,
-                           cfg.topk_approx_recall or None)
-        if client_k is not None:
-            # per-client budget: rank the provisioned selection by
-            # magnitude and keep only the client_k largest. Slots that
-            # point at zero coordinates (selection narrower than cfg.k)
-            # are harmless: where() writes 0.0 over 0.0.
-            _, sel = jax.lax.top_k(jnp.abs(to_transmit), cfg.k)
-            keep = jnp.zeros(to_transmit.shape, bool).at[sel].set(
-                jnp.arange(cfg.k) < client_k)
-            to_transmit = jnp.where(keep, to_transmit, 0.0)
+        if client_k is not None and not cfg.topk_approx_recall:
+            # per-client budget, selected in ONE pass: keep the first
+            # client_k slots of the stable selection order (the length-
+            # k_i prefix of the magnitude order — the same set the
+            # legacy topk-then-re-rank two-stage kept). Under the round
+            # vmap this is the batched per-row-k kernel path; masked
+            # coordinates keep their error-feedback mass below.
+            to_transmit = topk(to_transmit, cfg.k, row_k=client_k)
+        else:
+            to_transmit = topk(to_transmit, cfg.k,
+                               cfg.topk_approx_recall or None)
+            if client_k is not None:
+                # approx selection has no stable prefix to cut, so the
+                # budget still ranks the provisioned selection and keeps
+                # the client_k largest. Slots that point at zero
+                # coordinates (selection narrower than cfg.k) are
+                # harmless: where() writes 0.0 over 0.0.
+                _, sel = jax.lax.top_k(jnp.abs(to_transmit), cfg.k)
+                keep = jnp.zeros(to_transmit.shape, bool).at[sel].set(
+                    jnp.arange(cfg.k) < client_k)
+                to_transmit = jnp.where(keep, to_transmit, 0.0)
         support = to_transmit != 0
         if cfg.error_type == "local":
             error = jnp.where(support, 0.0, error)   # error feedback

@@ -55,6 +55,15 @@ def _momentum(gradient, velocity, rho):
     return gradient + rho * velocity
 
 
+def _fused_ok(cfg: FedConfig) -> bool:
+    """Gate for the fused server-update path (ops/topk_kernels.py):
+    exact selection only (approx_recall refuses by contract), opt-out
+    via --server_fused off, and the kernel backend/force gate."""
+    from commefficient_tpu.ops.topk_kernels import topk_kernel_ok
+    return (cfg.server_fused != "off"
+            and topk_kernel_ok(cfg.topk_approx_recall or None))
+
+
 def _fedavg(avg_update, state, cfg, lr):
     # lr is applied worker-side during local SGD; server applies momentum
     # only (ref :483-495, lr forced to 1 at :451).
@@ -75,9 +84,21 @@ def _uncompressed(gradient, state, cfg, lr, noise_rng):
 
 
 def _true_topk(gradient, state, cfg, lr):
+    if _fused_ok(cfg):
+        # one fused pass (ops/topk_kernels.fused_true_topk_pallas):
+        # momentum, error accumulation, streaming radix top-k and BOTH
+        # error-feedback residuals emit tile-by-tile — no sort, no
+        # scatter mask, no d-sized intermediate between the stages.
+        # Bitwise-identical to the chain below (tests/test_server_fused)
+        from commefficient_tpu.ops.topk_kernels import fused_true_topk_pallas
+        update, v, err = fused_true_topk_pallas(
+            gradient, state.Vvelocity, state.Verror, k=cfg.k,
+            rho=cfg.virtual_momentum)
+        return update * lr, ServerOptState(Vvelocity=v, Verror=err)
     v = _momentum(gradient, state.Vvelocity, cfg.virtual_momentum)
     err = state.Verror + v
-    update = topk(err, cfg.k, cfg.topk_approx_recall or None)
+    update = topk(err, cfg.k, cfg.topk_approx_recall or None,
+                  use_kernel=None if cfg.server_fused != "off" else False)
     support = update != 0
     # error feedback + momentum factor masking on the global top-k support
     err = jnp.where(support, 0.0, err)
@@ -98,15 +119,20 @@ def _sketched(sketched_grad, state, cfg, lr, sketch: CountSketch):
     # 'virtual' accumulates; 'none' recovers straight from the momentum table
     # (sketch+'local' is rejected by FedConfig.validate)
     err = state.Verror + v if cfg.error_type == "virtual" else v
-    # server-side estimate-all, routed through the batch-guard dispatch
-    # at batch 1 so it compiles the SAME 2-D grid kernel the vmapped
-    # client.py/client_store.py paths run — one resident estimate
-    # program instead of a 1-D grid twin (bitwise-identical either way,
-    # tests/test_sketch_kernels.py)
-    vals, idxs = topk_values_indices(
-        sketch.estimates_batched(err, use_kernel=True),
-        cfg.k,
-        cfg.topk_approx_recall or None)
+    # fused unsketch + exact top-k where the kernels dispatch (the (d,)
+    # estimate vector never materializes — ops/topk_kernels); otherwise
+    # the incumbent chain: estimate-all routed through the batch-guard
+    # dispatch at batch 1 so it compiles the SAME 2-D grid kernel the
+    # vmapped client.py/client_store.py paths run — one resident
+    # estimate program instead of a 1-D grid twin (bitwise-identical
+    # either way, tests/test_sketch_kernels.py, test_topk_kernels.py)
+    if cfg.server_fused != "off":
+        vals, idxs = sketch.unsketch_values_indices(
+            err, cfg.k, cfg.topk_approx_recall or None, use_kernel=True)
+    else:
+        vals, idxs = topk_values_indices(
+            sketch.estimates_batched(err, use_kernel=True),
+            cfg.k, cfg.topk_approx_recall or None, use_kernel=False)
     update = jnp.zeros((cfg.grad_dim,)).at[idxs].set(vals)
     # the update's footprint *in sketch space*: re-sketching only the k
     # nonzeros matches sketching the dense update (up to float summation

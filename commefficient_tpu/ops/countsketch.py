@@ -507,15 +507,52 @@ class CountSketch:
         return jax.vmap(lambda t: self.estimates(t, use_kernel))(
             table[None])[0]
 
+    def _fused_unsketch_ok(self, approx_recall, use_kernel: bool) -> bool:
+        """Gate for the fused unsketch+top-k kernel (ops/topk_kernels):
+        both the sketch kernel (the estimate stream runs in-VMEM from the
+        table) and the top-k kernel (exact selection only) must dispatch."""
+        from commefficient_tpu.ops.topk_kernels import topk_kernel_ok
+        return self._kernel_ok(use_kernel) and topk_kernel_ok(approx_recall)
+
     @partial(jax.jit, static_argnums=(0, 2, 3, 4))
     def unsketch(self, table: jax.Array, k: int,
                  approx_recall=None, use_kernel: bool = False) -> jax.Array:
         """Recover the top-k coordinates (dense d-vector, zeros elsewhere).
 
-        ``approx_recall`` selects with ``lax.approx_max_k`` instead of the
-        exact sort (see ops/topk.py; 5.4x at d=124M, k=50k)."""
+        With the kernels dispatched this is ONE fused pass: per-tile
+        estimates feed the streaming radix top-k directly from the
+        VMEM-resident table, and the (d,) estimate vector never exists
+        (ops/topk_kernels.unsketch_select_pallas — bitwise-identical to
+        the estimates -> topk chain below). ``approx_recall`` selects
+        with ``lax.approx_max_k`` instead of the exact sort (see
+        ops/topk.py; 5.4x at d=124M, k=50k) and refuses the fusion."""
         from commefficient_tpu.ops.topk import topk
+        if self._fused_unsketch_ok(approx_recall, use_kernel):
+            from commefficient_tpu.ops.topk_kernels import \
+                unsketch_select_pallas
+            masked, _ = unsketch_select_pallas(self, table, k=k)
+            return masked
         return topk(self.estimates(table, use_kernel), k, approx_recall)
+
+    @partial(jax.jit, static_argnums=(0, 2, 3, 4))
+    def unsketch_values_indices(self, table: jax.Array, k: int,
+                                approx_recall=None,
+                                use_kernel: bool = False):
+        """(values, indices) of the recovered top-k, in the exact stable
+        ``lax.top_k`` return order — the O(k) twin of ``unsketch`` for
+        callers that re-sketch or transmit the recovery
+        (federated/server._sketched) instead of densifying it."""
+        from commefficient_tpu.ops.topk import topk_values_indices
+        if self._fused_unsketch_ok(approx_recall, use_kernel):
+            from commefficient_tpu.ops.topk_kernels import (
+                unsketch_select_pallas, values_indices_from_mask)
+            masked, mask = unsketch_select_pallas(self, table, k=k)
+            return values_indices_from_mask(masked, mask, k)
+        # incumbent chain verbatim (the server call site's): the batched
+        # estimate entry so TPU compiles the SAME 2-D grid kernel the
+        # vmapped client paths run — one resident estimate program
+        return topk_values_indices(
+            self.estimates_batched(table, use_kernel), k, approx_recall)
 
     @partial(jax.jit, static_argnums=0)
     def l2estimate(self, table: jax.Array) -> jax.Array:

@@ -84,6 +84,12 @@ def build_parser(default_lr: float = 0.4) -> argparse.ArgumentParser:
     p.add_argument("--topk_approx_recall", type=float, default=0.0,
                    help="0 = exact top-k; in (0,1] = TPU approx_max_k with "
                         "this recall target (5.4x faster at d=124M)")
+    p.add_argument("--server_fused", choices=("auto", "off"),
+                   default="auto",
+                   help="'auto' = exact server top-k recovery runs as the "
+                        "fused streaming radix kernel where it dispatches "
+                        "(bitwise-identical to the lax.top_k chain); "
+                        "'off' = always the incumbent chain")
     # optimization
     p.add_argument("--local_momentum", type=float, default=0.0)
     p.add_argument("--virtual_momentum", type=float, default=0.0)

@@ -98,6 +98,43 @@ def test_sketch_batched_audit_fails_under_forced_fallback():
     assert "no pallas_call" in msgs
 
 
+@pytest.mark.parametrize("idx,mode", [(0, "true_topk"), (1, "sketch")])
+def test_server_update_fused_audit_passes_with_retrace(audited, idx, mode):
+    """The ISSUE-20 fused server update: the streaming radix/select
+    pallas_calls are in the traced program, no top_k/sort runs over the
+    d-stream, the live-(d,) output count sits at the fused budget, and
+    the compile cache stays at 1 across drives under
+    force_dispatch('kernel')."""
+    rep = audited("server_update_fused", idx, with_retrace=True)
+    assert rep.target == f"server_update_fused/{mode}"
+    assert rep.ok, rep.format()
+    fr = rep.rule("fused_server_update")
+    assert fr.ok and "pallas_calls seen: 3" in fr.notes
+    assert rep.stats.visited("pallas_call"), rep.stats.descended_into
+
+
+@pytest.mark.parametrize("mode", ["true_topk", "sketch"])
+def test_server_update_fused_audit_fails_on_rematerialized_chain(mode):
+    """Mutation: the SAME server update traced with
+    force_dispatch('fallback') — the re-materialized estimates ->
+    scores -> sort -> mask -> where chain a dispatch revert would
+    produce — must FAIL all three claims: missing pallas_calls,
+    a sort-unit selection over the d-stream, and a live-(d,) count
+    above the fused budget."""
+    from commefficient_tpu.analysis.targets import server_update_fused_target
+
+    rep = server_update_fused_target(mode, mutate=True).audit(
+        with_retrace=False)
+    assert rep.target == f"server_update_fused/{mode}(mutated)"
+    assert not rep.ok
+    fr = rep.rule("fused_server_update")
+    assert not fr.ok
+    msgs = " ".join(v.message for v in fr.violations)
+    assert "sort-unit selection over the d-stream" in msgs
+    assert "expected >= 2 pallas_call" in msgs
+    assert "exceed the fused-path budget" in msgs
+
+
 def test_gpt2_train_step_audit_passes_and_visits_remat(audited):
     rep = audited("gpt2")
     assert rep.ok, rep.format()

@@ -34,10 +34,12 @@ def test_registry_covers_every_row():
     a row cannot exist in one mode and be silently skipped by the
     other."""
     names = [n for n, _ in bench._bench_rows()]
-    assert len(names) == len(set(names)) == 34
+    assert len(names) == len(set(names)) == 36
     for must in ("cifar10_resnet9_fed_rounds_per_sec",
                  "cifar10_resnet9_per_worker_sketch_ab",
                  "gpt2_fetchsgd_per_worker_sketch_ab",
+                 "gpt2_server_update_fused_ab",
+                 "topk_hierarchical_ab",
                  "client_store_sketched_codec",
                  "checkpoint_save_restore_overhead",
                  "gpt2_personachat_tokens_per_sec_chip_flash_attn",
@@ -220,6 +222,26 @@ def test_per_worker_sketch_ab_row_traces_both_arms(dry):
         d=131_072, W=4, r=3, c=1_024)
     assert speedup is None
     assert info == {"d": 131_072, "W": 4, "r": 3, "c": 1_024}
+
+
+def test_server_update_fused_ab_row_traces_both_arms(dry):
+    """The BENCH_r09 fused-server-update A/B row traces BOTH dispatch
+    arms for BOTH selecting modes (true_topk, sketch) on CPU and asserts
+    pallas_call presence/absence per arm — so a server dispatch
+    regression fails CI's trace, not the next on-chip capture."""
+    speedup, info = bench.bench_server_update_fused_ab(
+        d=65_536, k=64, r=3, c=1_024)
+    assert speedup is None
+    assert info == {"d": 65_536, "k": 64, "r": 3, "c": 1_024}
+
+
+def test_topk_hierarchical_ab_row_traces_sweep_both_arms(dry):
+    """The BENCH_r09 top-k sweep row traces kernel and sort-unit arms at
+    every swept k through the PUBLIC topk dispatch."""
+    speedup, info = bench.bench_topk_hierarchical_ab(
+        d=65_536, ks=(64, 512))
+    assert speedup is None
+    assert info == {"d": 65_536, "ks": [64, 512]}
 
 
 def test_sketched_codec_row_traces_both_schemes(dry):

@@ -153,6 +153,16 @@ def test_main_emits_json_and_exits_zero_despite_failed_metrics(
     monkeypatch.setattr(
         bench, "bench_client_store_sketched_codec",
         lambda: (1.05, {"global_total_ms": 10.0, "tiled_total_ms": 9.5}))
+    monkeypatch.setattr(
+        bench, "bench_server_update_fused_ab",
+        lambda **kw: (1.6, {"true_topk_speedup_x": 2.1,
+                            "sketch_speedup_x": 1.6,
+                            "true_topk_bitwise_equal": True,
+                            "sketch_bitwise_equal": True}))
+    monkeypatch.setattr(
+        bench, "bench_topk_hierarchical_ab",
+        lambda **kw: (1.8, {"k50000_kernel_ms": 4.0,
+                            "k50000_sort_unit_ms": 7.2}))
 
     monkeypatch.setattr(
         bench, "bench_client_store_gather_scatter",
@@ -255,6 +265,8 @@ def test_main_emits_json_and_exits_zero_despite_failed_metrics(
     assert "cifar10_resnet9_per_worker_sketch_ab" in metrics
     assert "gpt2_fetchsgd_per_worker_sketch_ab" in metrics
     assert "client_store_sketched_codec" in metrics
+    assert "gpt2_server_update_fused_ab" in metrics
+    assert "topk_hierarchical_ab" in metrics
     assert "buffered_mesh_round_overhead_ab" in metrics
     assert "gpt2_decode_paged_tokens_per_sec_ab" in metrics
     assert "gpt2_decode_paged_quant_ab" in metrics

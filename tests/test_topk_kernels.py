@@ -1,0 +1,309 @@
+"""Streaming hierarchical top-k Pallas kernels vs the incumbent
+``jax.lax.top_k`` chain: BITWISE-identical, including tie-breaking. Runs
+the kernels in interpret mode on CPU (force_dispatch overrides the
+backend gate); on a TPU backend the same programs run compiled.
+
+The tie-break contract is the load-bearing part: ``lax.top_k`` is stable
+(equal scores taken in ascending index order), and the radix kernel
+reproduces that exactly by accepting threshold ties in flat-index order
+until ``k - n_gt`` are taken — pinned here under duplicated magnitudes
+crossing tile boundaries and sign-differing equal squares."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from commefficient_tpu.ops import topk_kernels as tk
+from commefficient_tpu.ops.countsketch import CountSketch
+from commefficient_tpu.ops.topk import topk, topk_values_indices
+
+
+def _jaxpr_has_pallas(fn, *args) -> bool:
+    return "pallas_call" in str(jax.make_jaxpr(fn)(*args))
+
+
+def _vec_with_ties(d, n_ties, seed, mag=1.5):
+    """Random vector with n_ties entries of EXACTLY equal magnitude and
+    mixed sign, scattered across the whole index range (so threshold
+    ties cross tile boundaries for multi-tile d)."""
+    rng = np.random.RandomState(seed)
+    x = rng.randn(d).astype(np.float32)
+    ties = rng.choice(d, n_ties, replace=False)
+    x[ties] = np.where(rng.rand(n_ties) < 0.5, mag, -mag).astype(np.float32)
+    return x
+
+
+@pytest.mark.parametrize("d,k", [(300, 7), (300, 300), (20_000, 50),
+                                 (20_000, 1), (8_192, 8_192)])
+def test_select_bit_identical_to_lax_topk(d, k):
+    rng = np.random.RandomState(d % 97)
+    vec = jnp.asarray(rng.randn(d).astype(np.float32))
+    ref = np.asarray(topk(vec, k))
+    with tk.force_dispatch("kernel"):
+        got = np.asarray(tk.topk_select_pallas(vec, k, k=k, interpret=True))
+    np.testing.assert_array_equal(got, ref)
+
+
+@pytest.mark.parametrize("n_ties,k", [(300, 100), (300, 299), (50, 30)])
+def test_tie_break_bit_agrees_across_tiles(n_ties, k):
+    """Duplicated magnitudes (mixed sign — equal SQUARES, different
+    values) scattered across a multi-tile stream: the kernel must keep
+    exactly the ties stable ``lax.top_k`` keeps (ascending index)."""
+    d = 20_000
+    vec = jnp.asarray(_vec_with_ties(d, n_ties, seed=3, mag=1.5))
+    ref = np.asarray(topk(vec, k))
+    with tk.force_dispatch("kernel"):
+        got = np.asarray(tk.topk_select_pallas(vec, k, k=k, interpret=True))
+    np.testing.assert_array_equal(got, ref)
+    # the threshold tie really is contested: more candidates than slots
+    assert (np.abs(np.asarray(vec)) == 1.5).sum() > k - 1
+
+
+def test_negative_values_with_equal_squares_keep_sign():
+    """-x and +x have identical scores; whichever the stable order keeps
+    must come through with its own sign bit (the dense mask copies the
+    VALUE, never the magnitude)."""
+    vec = jnp.asarray(np.array([0.1, -2.0, 2.0, -0.1, 2.0, -2.0, 0.0],
+                               np.float32))
+    for k in (1, 2, 3, 5):
+        ref = np.asarray(topk(vec, k))
+        with tk.force_dispatch("kernel"):
+            got = np.asarray(tk.topk_select_pallas(vec, k, k=k,
+                                                   interpret=True))
+        np.testing.assert_array_equal(got, ref)
+
+
+def test_all_zero_vector_selects_first_k_like_stable_sort():
+    vec = jnp.zeros((9_000,), jnp.float32)
+    ref = np.asarray(topk(vec, 12))
+    with tk.force_dispatch("kernel"):
+        got = np.asarray(tk.topk_select_pallas(vec, 12, k=12,
+                                               interpret=True))
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_fused_true_topk_bitwise_vs_incumbent_server_chain():
+    """The fused epilogue vs the ACTUAL incumbent program structure
+    (federated/server._true_topk verbatim, jitted): update, new
+    Vvelocity and new Verror all bitwise, in both dispatch modes."""
+    from functools import partial
+
+    d, k, rho = 20_000, 50, 0.9
+    rng = np.random.RandomState(7)
+    g = jnp.asarray(rng.randn(d).astype(np.float32))
+    vv = jnp.asarray(rng.randn(d).astype(np.float32))
+    ve = jnp.asarray(rng.randn(d).astype(np.float32))
+
+    @partial(jax.jit, static_argnames=("k", "rho"))
+    def incumbent(g, vvel, verr, *, k, rho):
+        v = g + rho * vvel
+        err = verr + v
+        update = topk(err, k)
+        support = update != 0
+        return (update, jnp.where(support, 0.0, v),
+                jnp.where(support, 0.0, err))
+
+    ref = incumbent(g, vv, ve, k=k, rho=rho)
+    for mode in ("kernel", "fallback"):
+        with tk.force_dispatch(mode):
+            got = tk.fused_true_topk_pallas(g, vv, ve, k=k, rho=rho,
+                                            interpret=True)
+        for a, b, nm in zip(ref, got, ("update", "Vvelocity", "Verror")):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                          err_msg=f"{nm} [{mode}]")
+
+
+def test_fused_true_topk_ties_and_selected_zero_residuals():
+    """Ties in the ERROR stream plus exact-zero errors at selected
+    positions: the incumbent's support convention is ``update != 0``
+    (a selected zero keeps its residual), replicated in-kernel."""
+    d, k, rho = 20_000, 120, 0.9
+    g = jnp.asarray(_vec_with_ties(d, 200, seed=11, mag=2.5))
+    rng = np.random.RandomState(12)
+    vv = jnp.asarray(rng.randn(d).astype(np.float32))
+    ve = jnp.asarray((-np.asarray(g) * 1.0
+                      - rho * np.asarray(vv)).astype(np.float32))
+    # verr + g + rho*vv is (mostly) exactly zero -> heavy zero-score ties
+    ref = jax.jit(lambda a, b, c: tk._fused_true_topk_fallback(
+        a, b, c, k=k, rho=rho))(g, vv, ve)
+    with tk.force_dispatch("kernel"):
+        got = tk.fused_true_topk_pallas(g, vv, ve, k=k, rho=rho,
+                                        interpret=True)
+    for a, b, nm in zip(ref, got, ("update", "Vvelocity", "Verror")):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=nm)
+
+
+def test_unsketch_select_bit_identical_to_estimates_then_topk():
+    """est-mode: the in-kernel per-tile estimate stream + select must
+    equal CountSketch.estimates -> masked top-k bitwise, mask included —
+    the (d,) estimate vector the kernel never materializes."""
+    d, c, r, k = 9_000, 512, 3, 40
+    cs = CountSketch(d=d, c=c, r=r, seed=5, scheme="tiled")
+    rng = np.random.RandomState(4)
+    vec = np.zeros(d, np.float32)
+    hot = rng.choice(d, 60, replace=False)
+    vec[hot] = rng.randn(60).astype(np.float32) * 10
+    table = cs.sketch_vec(vec)
+    est = cs.estimates(table, use_kernel=False)
+    ref_masked, ref_mask = jax.jit(
+        lambda e: tk._mask_fallback(e, jnp.int32(k), k, with_mask=True))(est)
+    for mode in ("kernel", "fallback"):
+        with tk.force_dispatch(mode):
+            got_masked, got_mask = tk.unsketch_select_pallas(
+                cs, table, k=k, interpret=True)
+        np.testing.assert_array_equal(np.asarray(got_masked),
+                                      np.asarray(ref_masked), err_msg=mode)
+        np.testing.assert_array_equal(np.asarray(got_mask),
+                                      np.asarray(ref_mask), err_msg=mode)
+
+
+def test_values_indices_from_mask_restores_exact_topk_order():
+    """Compaction + two-key sort must hand back (values, indices) in the
+    EXACT ``lax.top_k`` return order — descending score, ascending index
+    on ties — so downstream float summations see identical operand
+    order."""
+    d, k = 20_000, 200
+    vec = jnp.asarray(_vec_with_ties(d, 300, seed=9, mag=1.5))
+    ref_vals, ref_idx = topk_values_indices(vec, k)
+    with tk.force_dispatch("kernel"):
+        masked, mask = tk.topk_select_pallas(vec, k, k=k, with_mask=True,
+                                             interpret=True)
+    vals, idx = tk.values_indices_from_mask(masked, mask, k)
+    np.testing.assert_array_equal(np.asarray(idx), np.asarray(ref_idx))
+    np.testing.assert_array_equal(np.asarray(vals), np.asarray(ref_vals))
+
+
+def test_per_row_k_batched_kernel_matches_legacy_two_stage():
+    """Heterogeneous per-client k (PR 19): a vmapped call with a traced
+    per-row kk must dispatch the 2-D grid kernel and be bitwise equal to
+    the legacy two-stage path — topk at the static max-k, then keep each
+    row's first client_k slots in stable selection order."""
+    B, d, kmax = 3, 20_000, 40
+    rng = np.random.RandomState(21)
+    vecs = jnp.asarray(rng.randn(B, d).astype(np.float32))
+    kks = jnp.asarray(np.array([40, 17, 1], np.int32))
+
+    # legacy: stable top-k of kmax, then rank mask (client.py PR-19 block)
+    def legacy(v, kk):
+        dense = topk(v, kmax)
+        sq = dense * dense
+        _, order = jax.lax.top_k(sq, kmax)
+        keep = jnp.zeros(v.shape, bool).at[order].set(
+            jnp.arange(kmax) < kk)
+        return jnp.where(keep, dense, 0)
+
+    ref = jax.vmap(legacy)(vecs, kks)
+    with tk.force_dispatch("kernel"):
+        fn = jax.vmap(lambda v, kk: tk.topk_select_pallas(
+            v, kk, k=kmax, interpret=True))
+        assert _jaxpr_has_pallas(fn, vecs, kks)
+        got = fn(vecs, kks)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+    # fallback arm of the public per-row-k entry: same bits, no kernel
+    with tk.force_dispatch("fallback"):
+        fb = lambda m, kk: topk(m, kmax, row_k=kk)  # noqa: E731
+        assert not _jaxpr_has_pallas(fb, vecs, kks)
+        np.testing.assert_array_equal(np.asarray(fb(vecs, kks)),
+                                      np.asarray(ref))
+
+
+def test_nested_vmap_falls_back_to_xla_bitwise():
+    """A second batching level must NOT reach a kernel: the batched
+    entry is itself batch-guarded, so nested vmap maps the doubly-
+    vmapped XLA fallback (no pallas_call in the jaxpr) and stays
+    bitwise."""
+    d, k = 2_000, 9
+    rng = np.random.RandomState(23)
+    vecs = jnp.asarray(rng.randn(2, 3, d).astype(np.float32))
+    kks = jnp.asarray(np.array([[9, 4, 1], [2, 9, 5]], np.int32))
+    with tk.force_dispatch("kernel"):
+        fn = jax.vmap(jax.vmap(lambda v, kk: tk.topk_select_pallas(
+            v, kk, k=k, interpret=True)))
+        assert not _jaxpr_has_pallas(fn, vecs, kks)
+        got = fn(vecs, kks)
+    ref = jax.vmap(jax.vmap(
+        lambda v, kk: tk._mask_fallback(v, kk, k)))(vecs, kks)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_approx_recall_refuses_the_kernel():
+    """``approx_max_k`` is TPU-native and intentionally inexact — there
+    is nothing to bit-agree with, so the gate refuses even under forced
+    kernel dispatch and the public chain keeps the approx path."""
+    assert not tk.topk_kernel_ok(0.95)
+    with tk.force_dispatch("kernel"):
+        assert not tk.topk_kernel_ok(0.95)
+        assert tk.topk_kernel_ok(None)
+    with tk.force_dispatch("fallback"):
+        assert not tk.topk_kernel_ok(None)
+
+
+def test_topk_public_api_dispatches_kernel_under_force():
+    """ops.topk.topk / topk_values_indices route through the streaming
+    kernel when forced (the audit/bench mechanism) — bitwise, with the
+    pallas_call visible in the jaxpr — and approx_recall keeps the
+    incumbent approx path even when forced."""
+    d, k = 20_000, 50
+    rng = np.random.RandomState(31)
+    vec = jnp.asarray(rng.randn(d).astype(np.float32))
+    ref = np.asarray(topk(vec, k))
+    rv, ri = topk_values_indices(vec, k)
+    with tk.force_dispatch("kernel"):
+        assert _jaxpr_has_pallas(lambda v: topk(v, k), vec)
+        np.testing.assert_array_equal(np.asarray(topk(vec, k)), ref)
+        assert not _jaxpr_has_pallas(
+            lambda v: topk(v, k, approx_recall=0.9), vec)
+        assert _jaxpr_has_pallas(lambda v: topk_values_indices(v, k), vec)
+        kv, ki = topk_values_indices(vec, k)
+        np.testing.assert_array_equal(np.asarray(kv), np.asarray(rv))
+        np.testing.assert_array_equal(np.asarray(ki), np.asarray(ri))
+    with tk.force_dispatch("fallback"):
+        assert not _jaxpr_has_pallas(lambda v: topk(v, k), vec)
+        np.testing.assert_array_equal(np.asarray(topk(vec, k)), ref)
+
+
+def test_topk_2d_and_values_indices_2d_share_batched_selection():
+    """Satellite: topk_values_indices now takes 2-D input (per-row), and
+    2-D topk dispatches the batched kernel under force — both bitwise
+    against the per-row incumbent."""
+    B, d, k = 3, 9_000, 16
+    rng = np.random.RandomState(37)
+    mat = jnp.asarray(rng.randn(B, d).astype(np.float32))
+    ref_dense = np.stack([np.asarray(topk(mat[i], k)) for i in range(B)])
+    ref_vi = [topk_values_indices(mat[i], k) for i in range(B)]
+    with tk.force_dispatch("kernel"):
+        assert _jaxpr_has_pallas(lambda m: topk(m, k), mat)
+        np.testing.assert_array_equal(np.asarray(topk(mat, k)), ref_dense)
+        vals, idx = topk_values_indices(mat, k)
+    assert vals.shape == idx.shape == (B, k)
+    for i in range(B):
+        np.testing.assert_array_equal(np.asarray(vals[i]),
+                                      np.asarray(ref_vi[i][0]))
+        np.testing.assert_array_equal(np.asarray(idx[i]),
+                                      np.asarray(ref_vi[i][1]))
+    vals, idx = topk_values_indices(mat, k)  # backend-gated fallback path
+    for i in range(B):
+        np.testing.assert_array_equal(np.asarray(vals[i]),
+                                      np.asarray(ref_vi[i][0]))
+        np.testing.assert_array_equal(np.asarray(idx[i]),
+                                      np.asarray(ref_vi[i][1]))
+
+
+def test_topk_row_k_matches_per_row_masking():
+    """Satellite: ``topk(mat, k, row_k=...)`` — the public per-row-k
+    entry the heterogeneous-client path calls — equals topk + per-row
+    stable-rank masking in both dispatch modes."""
+    B, d, kmax = 4, 2_000, 12
+    rng = np.random.RandomState(41)
+    mat = jnp.asarray(rng.randn(B, d).astype(np.float32))
+    row_k = jnp.asarray(np.array([12, 5, 1, 12], np.int32))
+    ref = np.stack([
+        np.asarray(tk._mask_fallback(mat[i], row_k[i], kmax))
+        for i in range(B)])
+    got = np.asarray(topk(mat, kmax, row_k=row_k))
+    np.testing.assert_array_equal(got, ref)
+    with tk.force_dispatch("kernel"):
+        got_k = np.asarray(topk(mat, kmax, row_k=row_k))
+    np.testing.assert_array_equal(got_k, ref)
